@@ -1,0 +1,92 @@
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Metric: TwoTower CTR train-step throughput, examples/sec/chip on the real
+device (the BASELINE.json target metric family; the reference publishes no
+numbers — BASELINE.md — so ``vs_baseline`` compares against the recorded
+number in ``BENCH_BASELINE.json`` when present, else 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+
+
+def build_bench(batch_size: int = 8192, embed_dim: int = 64):
+    import jax.numpy as jnp
+
+    from tdfo_tpu.models.twotower import init_twotower
+    from tdfo_tpu.train.state import TrainState, make_adamw
+    from tdfo_tpu.train.step import make_train_step
+
+    size_map = {
+        "user": 500_000, "item": 200_000, "language": 32, "is_ebook": 2,
+        "format": 16, "publisher": 5_000, "pub_decade": 16,
+    }
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    model, params = init_twotower(jax.random.key(0), size_map, embed_dim, dtype=dtype)
+    state = TrainState.create(
+        apply_fn=model.apply, params=params, tx=make_adamw(3e-4, 1e-4)
+    )
+    rng = np.random.default_rng(0)
+    b = batch_size
+    batch = {
+        "user_id": rng.integers(0, size_map["user"], b, dtype=np.int32),
+        "item_id": rng.integers(0, size_map["item"], b, dtype=np.int32),
+        "language": rng.integers(0, size_map["language"], b, dtype=np.int32),
+        "is_ebook": rng.integers(0, 2, b, dtype=np.int32),
+        "format": rng.integers(0, size_map["format"], b, dtype=np.int32),
+        "publisher": rng.integers(0, size_map["publisher"], b, dtype=np.int32),
+        "pub_decade": rng.integers(0, size_map["pub_decade"], b, dtype=np.int32),
+        "avg_rating": rng.random(b, dtype=np.float32),
+        "num_pages": rng.random(b, dtype=np.float32),
+        "label": rng.integers(0, 2, b).astype(np.float32),
+    }
+    batch = jax.device_put(batch)
+    return make_train_step(), state, batch
+
+
+def main() -> None:
+    batch_size = 8192
+    step, state, batch = build_bench(batch_size)
+
+    # warmup + compile
+    state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+
+    n_iters = 50
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    examples_per_sec_per_chip = batch_size * n_iters / dt / n_chips
+
+    baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
+    vs_baseline = 1.0
+    if baseline_path.exists():
+        base = json.loads(baseline_path.read_text()).get("value")
+        if base:
+            vs_baseline = examples_per_sec_per_chip / base
+
+    print(
+        json.dumps(
+            {
+                "metric": "twotower_train_examples_per_sec_per_chip",
+                "value": round(examples_per_sec_per_chip, 1),
+                "unit": "examples/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""tdfo_tpu — a TPU-native distributed training framework for recommender
+workloads, providing the full capability surface of massquantity/tdfo
+(TwoTower CTR + Bert4Rec sequential recommendation, data/model/sequence
+parallelism, streaming data, checkpointing) re-designed for JAX/XLA/Pallas
+on device meshes.
+
+Layering (SURVEY.md §7):
+  core/      config + mesh + precision (L0 + distribution bootstrap)
+  data/      jagged tensors, preprocessing ETLs, streaming loaders (L1)
+  models/    TwoTower, Bert4Rec, transformer blocks (L2)
+  parallel/  sharded embedding collections, sharding plans, collectives (L3)
+  ops/       Pallas kernels + XLA compound ops (native compute layer)
+  train/     state, steps, metrics, checkpoint, epoch driver (L4)
+  utils/     logging, timing, profiling
+"""
+
+from tdfo_tpu.core.config import Config, MeshSpec, read_configs
+from tdfo_tpu.core.mesh import make_mesh, spoof_cpu_devices
+
+__version__ = "0.1.0"
+
+__all__ = ["Config", "MeshSpec", "read_configs", "make_mesh", "spoof_cpu_devices"]

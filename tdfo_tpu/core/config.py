@@ -1,0 +1,183 @@
+"""Config system: ``config.toml`` -> frozen :class:`Config` + ``size_map.json`` handshake.
+
+TPU-native unification of the three per-backend loaders in the reference
+(``jax-flax/utils.py:10-38``, ``tensorflow2/utils.py:10-48``,
+``torchrec/utils.py:8-39``).  One dataclass covers both workload families
+(TwoTower CTR and Bert4Rec sequential) plus the mesh/parallelism knobs that the
+reference scattered across ``cluster.json``, torchx env vars, and strategy
+factories.
+
+The ``size_map.json`` file written by preprocessing is the contract between the
+offline data layer and model construction (vocab sizes per categorical
+feature), exactly as in the reference (``jax-flax/preprocessing.py:273-275`` ->
+``jax-flax/utils.py:31-32``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tomllib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Mapping
+
+__all__ = ["Config", "MeshSpec", "read_configs", "load_size_map"]
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical TPU mesh description.
+
+    Replaces the reference's process-group / strategy / cluster.json plumbing
+    (``torchrec/train.py:197-198``, ``tensorflow2/train_dp.py:21-36``,
+    ``tensorflow2/train_ps.py:43-62``) with a single named-mesh spec.
+
+    Axis sizes of ``-1`` mean "use all remaining devices" (at most one axis may
+    be -1).  An axis of size 1 is kept in the mesh so sharding specs stay
+    stable regardless of topology.
+    """
+
+    data: int = -1  # batch / data-parallel axis
+    model: int = 1  # embedding-shard / tensor-parallel axis
+    seq: int = 1  # sequence/context-parallel axis (ring attention)
+    axis_names: tuple[str, ...] = ("data", "model", "seq")
+
+    def sizes(self) -> tuple[int, ...]:
+        return (self.data, self.model, self.seq)
+
+
+@dataclass(frozen=True)
+class Config:
+    """Unified training configuration.
+
+    Field-by-field parity sources:
+      * data/paths + streaming: ``jax-flax/config.toml``, ``jax-flax/utils.py:10-33``
+      * write_format / steps_per_execution / jit_xla / use_tpu:
+        ``tensorflow2/utils.py:10-38`` (jit_xla ``false -> None`` normalisation
+        kept at :func:`read_configs`)
+      * sequence-model params (n_heads..mask_prob, model_parallel):
+        ``torchrec/utils.py:8-34`` (incl. the ``max_len >= sliding_step`` assert)
+    """
+
+    # --- data (L1) ---
+    data_dir: Path = Path("data/goodreads")
+    train_data: str = "train_part_*.parquet"
+    eval_data: str = "eval_part_*.parquet"
+    streaming: bool = True
+    write_format: str = "parquet"
+    num_workers: int = 0
+    shuffle_buffer_size: int = 2_000_000
+
+    # --- optimisation (L4) ---
+    n_epochs: int = 10
+    learning_rate: float = 3e-4
+    weight_decay: float = 1e-4
+    per_device_train_batch_size: int = 2048
+    per_device_eval_batch_size: int = 2048
+    mixed_precision: bool = False
+    loss_scale: str = "dynamic"  # "dynamic" | "none" (only used with f16)
+    seed: int = 42
+
+    # --- model (L2) ---
+    model: str = "twotower"  # "twotower" | "bert4rec" | "dlrm"
+    embed_dim: int = 16
+    # sequential-model params (Bert4Rec)
+    n_heads: int = 2
+    n_layers: int = 2
+    max_len: int = 20
+    sliding_step: int = 10
+    mask_prob: float = 0.2
+    dropout: float = 0.1
+
+    # --- parallelism (L3) ---
+    model_parallel: bool = False
+    embedding_sharding: str = "row"  # "row" | "column" | "table" | "replicated"
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+
+    # --- runtime knobs ---
+    steps_per_execution: int = 1
+    jit_xla: bool | None = None
+    use_tpu: bool = False
+    checkpoint_dir: str | None = None
+    checkpoint_every_n_epochs: int = 10
+    log_every_n_steps: int = 100
+    profile: bool = False
+
+    # --- preprocessing handshake ---
+    size_map: Mapping[str, int] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.max_len < self.sliding_step:
+            raise ValueError(
+                f"max_len ({self.max_len}) must be >= sliding_step ({self.sliding_step})"
+            )
+        if self.write_format not in ("parquet", "tfrecord"):
+            raise ValueError(f"unsupported write_format: {self.write_format!r}")
+        if self.embedding_sharding not in ("row", "column", "table", "replicated"):
+            raise ValueError(f"unknown embedding_sharding: {self.embedding_sharding!r}")
+
+    @property
+    def global_train_batch_size(self) -> int:
+        import jax
+
+        return self.per_device_train_batch_size * jax.device_count()
+
+    def replace(self, **kwargs: Any) -> "Config":
+        return dataclasses.replace(self, **kwargs)
+
+
+def load_size_map(data_dir: Path) -> dict[str, int]:
+    """Load the preprocessing -> training vocab-size contract if present."""
+    path = Path(data_dir) / "size_map.json"
+    if path.exists():
+        with open(path) as f:
+            return {k: int(v) for k, v in json.load(f).items()}
+    return {}
+
+
+_CONFIG_FIELDS = {f.name for f in dataclasses.fields(Config)}
+_MESH_FIELDS = {f.name for f in dataclasses.fields(MeshSpec)} - {"axis_names"}
+
+
+def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
+    """Read ``config.toml`` (flat keys, reference-compatible) into a Config.
+
+    Reference-compatible behaviours preserved:
+      * flat toml keys (no sections required); unknown keys are rejected so
+        typos fail loudly (the reference dataclasses did this implicitly).
+      * ``jit_xla = false`` normalised to ``None`` (``tensorflow2/utils.py:36-37``).
+      * ``size_map.json`` next to the data dir merged in when it exists.
+      * a ``[mesh]`` table maps onto :class:`MeshSpec` (new capability).
+    """
+    raw: dict[str, Any] = {}
+    if config_path is not None:
+        with open(config_path, "rb") as f:
+            raw = tomllib.load(f)
+    raw.update(overrides)
+
+    mesh_raw = raw.pop("mesh", {})
+    if isinstance(mesh_raw, MeshSpec):
+        mesh = mesh_raw
+    else:
+        unknown_mesh = set(mesh_raw) - _MESH_FIELDS
+        if unknown_mesh:
+            raise ValueError(f"unknown mesh config keys: {sorted(unknown_mesh)}")
+        mesh = MeshSpec(**mesh_raw)
+
+    unknown = set(raw) - _CONFIG_FIELDS
+    if unknown:
+        raise ValueError(f"unknown config keys: {sorted(unknown)}")
+
+    if raw.get("jit_xla") is False:
+        raw["jit_xla"] = None
+    if "data_dir" in raw:
+        raw["data_dir"] = Path(raw["data_dir"]).expanduser()
+
+    cfg = Config(mesh=mesh, **raw)
+    if not cfg.size_map:
+        size_map = load_size_map(cfg.data_dir)
+        if size_map:
+            cfg = cfg.replace(size_map=size_map)
+    return cfg

@@ -1,0 +1,144 @@
+"""Device-mesh bootstrap: the single distribution mechanism of the framework.
+
+Replaces all three per-backend distribution planes in the reference with one
+named-mesh abstraction (SURVEY.md §2.3):
+
+  * ``torch.distributed.init_process_group`` + NCCL/gloo
+    (``torchrec/train.py:186-198``)  -> :func:`initialize_distributed` +
+    XLA collectives over ICI/DCN.
+  * ``tf.distribute`` strategy factories (``tensorflow2/train_dp.py:21-36``)
+    and the gRPC PS cluster (``tensorflow2/train_ps.py:43-62``) -> sharding
+    specs on the mesh; "parameter servers" are just sharded arrays.
+  * ``jax.pmap`` (``jax-flax/train_dp.py:179-186``) -> ``jax.jit`` with
+    :class:`~jax.sharding.NamedSharding` (GSPMD).
+
+Axes convention:
+  ``data``  - batch-parallel axis (DP).
+  ``model`` - embedding/tensor-parallel axis (MP); row/column/table-wise
+              embedding shards live along it.
+  ``seq``   - sequence/context-parallel axis (ring attention).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdfo_tpu.core.config import MeshSpec
+
+__all__ = [
+    "make_mesh",
+    "initialize_distributed",
+    "spoof_cpu_devices",
+    "data_sharding",
+    "replicated_sharding",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+]
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def spoof_cpu_devices(n: int = 8) -> None:
+    """Force N virtual CPU devices for tests (call BEFORE first jax use).
+
+    The jax-idiomatic equivalent of every fake-cluster mechanism in the
+    reference (SURVEY.md §4.1): the commented-out
+    ``xla_force_host_platform_device_count`` hint at
+    ``jax-flax/train_dp.py:21-24``, TF logical devices, the in-process gRPC
+    PS cluster, and torchrec's ``mp.spawn`` gloo harness.  Uses the config
+    knobs rather than env vars so it also works when a sitecustomize has
+    already imported jax and pinned another platform.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bootstrap (DCN across slices, ICI within a slice).
+
+    Fills the multi-host gap the reference's jax backend left open (it was
+    single-host pmap only; ``torchrec`` used env-var rank/world from torchx,
+    ``torchrec/data.py:53-54``).  Reads the same style of env vars when args
+    are not given, then delegates to ``jax.distributed.initialize``.
+    No-op for single-process runs.
+    """
+    num_processes = num_processes or int(os.environ.get("WORLD_SIZE", "1"))
+    if num_processes <= 1:
+        return
+    process_id = process_id if process_id is not None else int(os.environ.get("RANK", "0"))
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _resolve_sizes(spec: MeshSpec, n_devices: int) -> tuple[int, ...]:
+    sizes = list(spec.sizes())
+    wildcard = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wildcard) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    fixed = math.prod(s for s in sizes if s != -1)
+    if wildcard:
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed mesh axes {sizes}"
+            )
+        sizes[wildcard[0]] = n_devices // fixed
+    if math.prod(sizes) != n_devices:
+        raise ValueError(f"mesh {sizes} != device count {n_devices}")
+    return tuple(sizes)
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build the named device mesh.
+
+    Device order follows ``jax.devices()`` which already reflects physical
+    ICI topology on TPU slices; the ``data`` axis is outermost so model-axis
+    collectives (embedding all-to-all) ride the innermost — fastest — ICI
+    links.
+    """
+    spec = spec or MeshSpec()
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = _resolve_sizes(spec, len(devices))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, spec.axis_names)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_sharding(mesh: Mesh, pspec: P) -> NamedSharding:
+    return NamedSharding(mesh, pspec)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Leading (batch) dim sharded over ``data``, all other dims replicated."""
+    return _cached_sharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return _cached_sharding(mesh, P())

@@ -1,0 +1,118 @@
+"""Jagged (ragged) tensors — the framework's KeyedJaggedTensor equivalent.
+
+TPU-native re-design of torchrec's ``KeyedJaggedTensor``
+(``torchrec/train.py:33-41`` builds one per batch;
+``torchrec/models.py:163-178,208-212`` consumes it).  Differences forced by
+XLA:
+
+  * **Static shapes.** XLA traces once; `values` therefore has a fixed
+    capacity ``N = sum(lengths)`` padded up to a static bound.  A boolean
+    validity is derivable from ``lengths``; trailing slots hold ``pad_id``.
+  * **Offsets are derived, not stored** — ``offsets = cumsum(lengths)`` is
+    free under XLA fusion, so the canonical representation is
+    ``(values[N], lengths[B])``.
+  * ``jagged_to_dense`` / ``dense_to_jagged`` (fbgemm kernel parity,
+    ``torchrec/models.py:168-172``) are expressed as gather/scatter with
+    static ``max_len`` so they tile onto the VPU; a Pallas variant lives in
+    ``tdfo_tpu/ops`` for the large-batch hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["JaggedTensor", "KeyedJagged", "jagged_to_dense", "dense_to_jagged"]
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class JaggedTensor:
+    """One ragged feature: ``B`` rows flattened into ``values`` with per-row
+    ``lengths``.  ``values.shape[0]`` is the static capacity; entries at or
+    beyond ``offsets[i] + lengths[i]`` are padding."""
+
+    values: jax.Array  # [N] int32 (ids) or [N, D] float
+    lengths: jax.Array  # [B] int32
+
+    @property
+    def offsets(self) -> jax.Array:
+        """Exclusive offsets, shape [B+1]."""
+        return jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(self.lengths, dtype=jnp.int32)]
+        )
+
+    @property
+    def batch_size(self) -> int:
+        return self.lengths.shape[0]
+
+    def to_dense(self, max_len: int, pad_value=0) -> jax.Array:
+        return jagged_to_dense(self.values, self.lengths, max_len, pad_value)
+
+    @classmethod
+    def from_dense(cls, dense: jax.Array, lengths: jax.Array) -> "JaggedTensor":
+        """Inverse of :meth:`to_dense` with capacity ``B * max_len``."""
+        values = dense_to_jagged(dense, lengths)
+        return cls(values=values, lengths=lengths)
+
+    @classmethod
+    def from_lists(cls, rows: list[np.ndarray | list], capacity: int | None = None,
+                   dtype=np.int32) -> "JaggedTensor":
+        """Host-side constructor (KJT.from_lengths_sync parity,
+        ``torchrec/train.py:33-41``)."""
+        lengths = np.asarray([len(r) for r in rows], dtype=np.int32)
+        flat = np.concatenate([np.asarray(r, dtype=dtype) for r in rows]) if rows else np.zeros((0,), dtype)
+        n = int(lengths.sum())
+        capacity = capacity or n
+        if capacity < n:
+            raise ValueError(f"capacity {capacity} < total length {n}")
+        values = np.zeros((capacity,), dtype=dtype)
+        values[:n] = flat
+        return cls(values=jnp.asarray(values), lengths=jnp.asarray(lengths))
+
+
+# A keyed collection of jagged features (KJT parity) is a plain dict — idiomatic
+# pytree; no bespoke container needed under jax transforms.
+KeyedJagged = Mapping[str, JaggedTensor]
+
+
+def jagged_to_dense(values: jax.Array, lengths: jax.Array, max_len: int, pad_value=0) -> jax.Array:
+    """``[N] -> [B, max_len]`` (or ``[N, D] -> [B, max_len, D]``).
+
+    fbgemm ``jagged_2d_to_dense`` parity (``torchrec/models.py:168-172``),
+    expressed as one vectorised gather with a validity mask — fuses into
+    neighbouring ops under XLA instead of launching a custom CUDA kernel.
+    Rows longer than ``max_len`` are truncated (keeping the head, matching
+    fbgemm).
+    """
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
+    )
+    b = lengths.shape[0]
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]  # [1, T]
+    gather_idx = offsets[:-1, None] + pos  # [B, T]
+    valid = pos < lengths[:, None]  # [B, T]
+    gather_idx = jnp.where(valid, gather_idx, 0)
+    dense = jnp.take(values, gather_idx, axis=0)  # [B, T, ...]
+    mask = valid if dense.ndim == 2 else valid[..., None]
+    return jnp.where(mask, dense, jnp.asarray(pad_value, dense.dtype))
+
+
+def dense_to_jagged(dense: jax.Array, lengths: jax.Array) -> jax.Array:
+    """``[B, T] -> [N=B*T]`` packed values (fbgemm ``dense_to_jagged`` parity).
+
+    Static capacity B*T; valid entries are left-compacted via an argsort on
+    validity (stable sort keeps row-major order), so ``values[:sum(lengths)]``
+    is the packed jagged payload.
+    """
+    b, t = dense.shape[0], dense.shape[1]
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    valid = (pos < lengths[:, None]).reshape(-1)  # [B*T]
+    flat = dense.reshape((b * t,) + dense.shape[2:])
+    # stable sort: valid entries (key 0) first, in original order
+    order = jnp.argsort(jnp.where(valid, 0, 1), stable=True)
+    return jnp.take(flat, order, axis=0)

@@ -1,0 +1,110 @@
+"""Sharding plans: map a train-state pytree onto the mesh by rule.
+
+This is the framework's replacement for three reference mechanisms at once
+(SURVEY.md §2.3): torchrec's sharding planner inside
+``DistributedModelParallel`` (``torchrec/train.py:241-247``), TF's
+``MinSizePartitioner`` variable partitioner (``tensorflow2/train_ps.py:55-58``),
+and the implicit full replication of ``flax.jax_utils.replicate``
+(``jax-flax/train_dp.py:186``).  A plan is just a function from tree paths to
+``PartitionSpec``s — applied uniformly to params AND optimizer state (optax
+states mirror the param tree, so the same rule shards Adam's ``mu``/``nu``
+alongside each table).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdfo_tpu.core.mesh import MODEL_AXIS
+
+__all__ = [
+    "PlanRule",
+    "rowwise_embedding_rule",
+    "make_sharding_plan",
+    "shard_state",
+    "min_size_partitioner_rule",
+]
+
+# A rule maps (path_string, leaf) -> PartitionSpec or None (meaning "no match").
+PlanRule = Callable[[str, Any], P | None]
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "name", getattr(k, "idx", k)))) for k in path
+    )
+
+
+def rowwise_embedding_rule(
+    mesh: Mesh,
+    pattern: str = r"embed",
+    min_rows: int | None = None,
+    axis: str = MODEL_AXIS,
+) -> PlanRule:
+    """Row-wise shard embedding tables (vocab dim over the model axis).
+
+    torchrec ROW_WISE sharding equivalent.  Tables whose path matches
+    ``pattern``, with >=2 dims and a leading dim divisible by the axis size
+    (and >= ``min_rows`` when given), get ``P(axis, None)``.
+    """
+    n = mesh.shape[axis]
+    rx = re.compile(pattern)
+
+    def rule(path: str, leaf) -> P | None:
+        if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+            return None
+        if not rx.search(path):
+            return None
+        rows = leaf.shape[0]
+        if rows % n != 0:
+            return None
+        if min_rows is not None and rows < min_rows:
+            return None
+        return P(axis, *([None] * (leaf.ndim - 1)))
+
+    return rule
+
+
+def min_size_partitioner_rule(
+    mesh: Mesh,
+    min_shard_bytes: int = 256 * 1024,
+    axis: str = MODEL_AXIS,
+) -> PlanRule:
+    """TF ``MinSizePartitioner`` parity (tensorflow2/train_ps.py:55-58):
+    shard any variable whose per-shard size would stay >= min_shard_bytes."""
+    n = mesh.shape[axis]
+
+    def rule(path: str, leaf) -> P | None:
+        if not hasattr(leaf, "ndim") or leaf.ndim < 1 or n <= 1:
+            return None
+        nbytes = leaf.size * leaf.dtype.itemsize
+        if nbytes // n < min_shard_bytes or leaf.shape[0] % n != 0:
+            return None
+        return P(axis, *([None] * (leaf.ndim - 1)))
+
+    return rule
+
+
+def make_sharding_plan(tree: Any, mesh: Mesh, *rules: PlanRule):
+    """Tree of NamedShardings: first matching rule wins, default replicated."""
+    repl = NamedSharding(mesh, P())
+
+    def assign(path, leaf):
+        p = _path_str(path)
+        for rule in rules:
+            spec = rule(p, leaf)
+            if spec is not None:
+                return NamedSharding(mesh, spec)
+        return repl
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def shard_state(state: Any, mesh: Mesh, *rules: PlanRule):
+    """device_put a TrainState (or any pytree) according to the plan."""
+    plan = make_sharding_plan(state, mesh, *rules)
+    return jax.device_put(state, plan)

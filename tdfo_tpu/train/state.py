@@ -1,0 +1,64 @@
+"""Train state: params + optimizer state + (optional) loss-scale, as a pytree.
+
+Supersedes the three reference variants: flax ``TrainState`` + optax adamw
+(``jax-flax/train.py:17-27``), the DynamicScale-carrying subclass
+(``jax-flax/train_dp.py:28-45``), and torchrec's ``CombinedOptimizer`` of a
+fused in-backward sparse optimizer + dense Adam (``torchrec/train.py:248-254``).
+The sparse/dense split is mirrored here: params under ``SPARSE_COLLECTION``
+table names can be excluded from the dense optax transform and updated by the
+row-sparse path in ``tdfo_tpu/parallel/embedding`` instead.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import optax
+
+from tdfo_tpu.core.precision import DynamicLossScale
+
+__all__ = ["TrainState", "make_adamw"]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    loss_scale: DynamicLossScale | None
+    apply_fn: Callable = field(metadata=dict(static=True))
+    tx: optax.GradientTransformation = field(metadata=dict(static=True))
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, loss_scale=None) -> "TrainState":
+        import jax.numpy as jnp
+
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            loss_scale=loss_scale,
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    def apply_gradients(self, grads) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return TrainState(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            loss_scale=self.loss_scale,
+            apply_fn=self.apply_fn,
+            tx=self.tx,
+        )
+
+
+def make_adamw(learning_rate: float, weight_decay: float) -> optax.GradientTransformation:
+    """The reference's optimizer everywhere (jax-flax/train.py:24-26,
+    tensorflow2/train.py:13, torchrec fused ADAM train.py:236-240)."""
+    return optax.adamw(learning_rate=learning_rate, weight_decay=weight_decay)

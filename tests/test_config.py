@@ -1,0 +1,80 @@
+import json
+from pathlib import Path
+
+import pytest
+
+from tdfo_tpu.core.config import Config, MeshSpec, read_configs
+
+
+def test_defaults_roundtrip():
+    cfg = read_configs()
+    assert cfg.n_epochs == 10
+    assert cfg.embed_dim == 16
+    assert cfg.mesh == MeshSpec()
+
+
+def test_reference_compatible_toml(tmp_path: Path):
+    # exact key set of the reference's jax-flax/config.toml
+    (tmp_path / "config.toml").write_text(
+        """
+data_dir = "{d}"
+train_data = "train_part_*.parquet"
+eval_data = "eval_part_*.parquet"
+streaming = true
+n_epochs = 3
+learning_rate = 3e-4
+weight_decay = 1e-4
+embed_dim = 16
+per_device_train_batch_size = 2048
+per_device_eval_batch_size = 2048
+mixed_precision = false
+seed = 42
+""".format(d=tmp_path)
+    )
+    (tmp_path / "size_map.json").write_text(json.dumps({"user": 100, "item": 50}))
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.n_epochs == 3
+    assert cfg.size_map == {"user": 100, "item": 50}
+    assert cfg.data_dir == tmp_path
+
+
+def test_torchrec_compatible_toml(tmp_path: Path):
+    (tmp_path / "config.toml").write_text(
+        """
+data_dir = "/data"
+n_heads = 2
+n_layers = 2
+max_len = 20
+sliding_step = 10
+mask_prob = 0.2
+model_parallel = true
+num_workers = 2
+seed = 42
+"""
+    )
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.model_parallel and cfg.max_len == 20
+
+
+def test_jit_xla_false_normalised(tmp_path: Path):
+    (tmp_path / "config.toml").write_text("jit_xla = false\n")
+    assert read_configs(tmp_path / "config.toml").jit_xla is None
+    (tmp_path / "config.toml").write_text("jit_xla = true\n")
+    assert read_configs(tmp_path / "config.toml").jit_xla is True
+
+
+def test_unknown_key_rejected(tmp_path: Path):
+    (tmp_path / "config.toml").write_text("bogus_key = 1\n")
+    with pytest.raises(ValueError, match="bogus_key"):
+        read_configs(tmp_path / "config.toml")
+
+
+def test_max_len_sliding_step_assert():
+    with pytest.raises(ValueError, match="sliding_step"):
+        Config(max_len=5, sliding_step=10)
+
+
+def test_mesh_table(tmp_path: Path):
+    (tmp_path / "config.toml").write_text("[mesh]\ndata = 4\nmodel = 2\nseq = 1\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.mesh.sizes() == (4, 2, 1)

@@ -1,0 +1,47 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tdfo_tpu.data.jagged import JaggedTensor, dense_to_jagged, jagged_to_dense
+
+
+def test_from_lists_and_offsets():
+    jt = JaggedTensor.from_lists([[1, 2, 3], [4], [5, 6]])
+    assert jt.batch_size == 3
+    np.testing.assert_array_equal(jt.lengths, [3, 1, 2])
+    np.testing.assert_array_equal(jt.offsets, [0, 3, 4, 6])
+    np.testing.assert_array_equal(jt.values, [1, 2, 3, 4, 5, 6])
+
+
+def test_to_dense_pad_and_truncate():
+    jt = JaggedTensor.from_lists([[1, 2, 3], [4], [5, 6]])
+    dense = jt.to_dense(max_len=2, pad_value=0)
+    np.testing.assert_array_equal(dense, [[1, 2], [4, 0], [5, 6]])
+    dense4 = jt.to_dense(max_len=4, pad_value=-1)
+    np.testing.assert_array_equal(dense4, [[1, 2, 3, -1], [4, -1, -1, -1], [5, 6, -1, -1]])
+
+
+def test_to_dense_2d_values():
+    values = jnp.arange(12.0).reshape(6, 2)
+    lengths = jnp.asarray([2, 1, 3], jnp.int32)
+    dense = jagged_to_dense(values, lengths, max_len=3, pad_value=0.0)
+    assert dense.shape == (3, 3, 2)
+    np.testing.assert_array_equal(dense[0, 0], [0.0, 1.0])
+    np.testing.assert_array_equal(dense[1, 1], [0.0, 0.0])  # padded
+    np.testing.assert_array_equal(dense[2, 2], [10.0, 11.0])
+
+
+def test_dense_jagged_roundtrip():
+    rows = [[7, 8], [9], [10, 11, 12]]
+    jt = JaggedTensor.from_lists(rows)
+    dense = jt.to_dense(max_len=3)
+    packed = dense_to_jagged(dense, jt.lengths)
+    np.testing.assert_array_equal(packed[:6], [7, 8, 9, 10, 11, 12])
+    jt2 = JaggedTensor.from_dense(dense, jt.lengths)
+    np.testing.assert_array_equal(jt2.to_dense(max_len=3), dense)
+
+
+def test_capacity_padding():
+    jt = JaggedTensor.from_lists([[1], [2, 3]], capacity=10)
+    assert jt.values.shape == (10,)
+    dense = jt.to_dense(max_len=2)
+    np.testing.assert_array_equal(dense, [[1, 0], [2, 3]])
