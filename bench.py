@@ -18,7 +18,10 @@ import numpy as np
 
 def build_bench(batch_size: int = 8192, embed_dim: int = 64):
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
     from tdfo_tpu.models.twotower import init_twotower
     from tdfo_tpu.train.state import TrainState, make_adamw
     from tdfo_tpu.train.step import make_train_step
@@ -30,11 +33,15 @@ def build_bench(batch_size: int = 8192, embed_dim: int = 64):
     platform = jax.devices()[0].platform
     dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
     model, params = init_twotower(jax.random.key(0), size_map, embed_dim, dtype=dtype)
-    state = TrainState.create(
-        apply_fn=model.apply, params=params, tx=make_adamw(3e-4, 1e-4)
+    # data-parallel over every chip present; per-chip throughput then divides
+    # honestly on multi-device hosts
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    state = jax.device_put(
+        TrainState.create(apply_fn=model.apply, params=params, tx=make_adamw(3e-4, 1e-4)),
+        NamedSharding(mesh, P()),
     )
     rng = np.random.default_rng(0)
-    b = batch_size
+    b = batch_size * mesh.shape["data"]
     batch = {
         "user_id": rng.integers(0, size_map["user"], b, dtype=np.int32),
         "item_id": rng.integers(0, size_map["item"], b, dtype=np.int32),
@@ -47,13 +54,12 @@ def build_bench(batch_size: int = 8192, embed_dim: int = 64):
         "num_pages": rng.random(b, dtype=np.float32),
         "label": rng.integers(0, 2, b).astype(np.float32),
     }
-    batch = jax.device_put(batch)
-    return make_train_step(), state, batch
+    batch = jax.device_put(batch, NamedSharding(mesh, P("data")))
+    return make_train_step(mesh=mesh), state, batch, b
 
 
 def main() -> None:
-    batch_size = 8192
-    step, state, batch = build_bench(batch_size)
+    step, state, batch, global_batch = build_bench()
 
     # warmup + compile
     state, loss = step(state, batch)
@@ -67,7 +73,7 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     n_chips = jax.device_count()
-    examples_per_sec_per_chip = batch_size * n_iters / dt / n_chips
+    examples_per_sec_per_chip = global_batch * n_iters / dt / n_chips
 
     baseline_path = Path(__file__).parent / "BENCH_BASELINE.json"
     vs_baseline = 1.0

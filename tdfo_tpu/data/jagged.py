@@ -26,7 +26,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["JaggedTensor", "KeyedJagged", "jagged_to_dense", "dense_to_jagged"]
+__all__ = [
+    "JaggedTensor",
+    "KeyedJagged",
+    "jagged_to_dense",
+    "dense_to_jagged",
+    "lengths_to_offsets",
+]
+
+
+def lengths_to_offsets(lengths: jax.Array) -> jax.Array:
+    """[B] lengths -> [B+1] exclusive offsets."""
+    return jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
+    )
 
 
 @jax.tree_util.register_dataclass
@@ -42,9 +55,7 @@ class JaggedTensor:
     @property
     def offsets(self) -> jax.Array:
         """Exclusive offsets, shape [B+1]."""
-        return jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), jnp.cumsum(self.lengths, dtype=jnp.int32)]
-        )
+        return lengths_to_offsets(self.lengths)
 
     @property
     def batch_size(self) -> int:
@@ -89,10 +100,7 @@ def jagged_to_dense(values: jax.Array, lengths: jax.Array, max_len: int, pad_val
     Rows longer than ``max_len`` are truncated (keeping the head, matching
     fbgemm).
     """
-    offsets = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lengths, dtype=jnp.int32)]
-    )
-    b = lengths.shape[0]
+    offsets = lengths_to_offsets(lengths)
     pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]  # [1, T]
     gather_idx = offsets[:-1, None] + pos  # [B, T]
     valid = pos < lengths[:, None]  # [B, T]
@@ -115,4 +123,8 @@ def dense_to_jagged(dense: jax.Array, lengths: jax.Array) -> jax.Array:
     flat = dense.reshape((b * t,) + dense.shape[2:])
     # stable sort: valid entries (key 0) first, in original order
     order = jnp.argsort(jnp.where(valid, 0, 1), stable=True)
-    return jnp.take(flat, order, axis=0)
+    packed = jnp.take(flat, order, axis=0)
+    # invariant: slots past sum(lengths) hold 0, not leftover dense padding
+    tail_valid = jnp.take(valid, order)
+    mask = tail_valid if packed.ndim == 1 else tail_valid[:, None]
+    return jnp.where(mask, packed, 0)

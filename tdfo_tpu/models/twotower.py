@@ -77,8 +77,14 @@ class TwoTower(nn.Module):
             )
             for feat in TWOTOWER_CATEGORICAL
         }
-        self.user_tower = Tower(self.embed_dim, self.activation, self.dtype, name="user_tower")
-        self.item_tower = Tower(self.embed_dim, self.activation, self.dtype, name="item_tower")
+        self.user_tower = Tower(
+            self.embed_dim, self.activation, self.dtype,
+            kernel_init=self.kernel_init, name="user_tower",
+        )
+        self.item_tower = Tower(
+            self.embed_dim, self.activation, self.dtype,
+            kernel_init=self.kernel_init, name="item_tower",
+        )
 
     def __call__(self, x: Mapping[str, jax.Array]) -> jax.Array:
         u = self.user_embeddings(x)

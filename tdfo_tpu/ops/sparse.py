@@ -1,0 +1,184 @@
+"""Row-sparse gradient aggregation + optimizer updates.
+
+TPU-native replacement for fbgemm's fused in-backward embedding optimizers
+(``EmbOptimType.ADAM/SGD/EXACT_ADAGRAD`` used at ``torchrec/train.py:191-195``
+inside ``DistributedModelParallel``).  fbgemm updates only the rows touched by
+the batch during the backward pass; the equivalent here is:
+
+  1. the train step computes gradients w.r.t. the *gathered rows* (an
+     activation), never materialising a dense [V, D] gradient;
+  2. :func:`dedupe_grads` merges duplicate ids with a segment-sum;
+  3. a sparse update (:func:`sparse_sgd` / :func:`sparse_adam` /
+     :func:`sparse_adagrad`) gathers the touched optimizer-state rows,
+     updates them, and scatters back — O(B*D) work and memory traffic per
+     step instead of O(V*D), which is what makes >=1B-row tables feasible
+     (SURVEY.md §7 hard part #2).
+
+All functions are jit-friendly (static unique-capacity), donation-safe, and
+shard-transparent: under GSPMD a row-sharded table turns the gather/scatter
+into the appropriate ICI collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "dedupe_grads",
+    "sparse_sgd",
+    "sparse_adam",
+    "sparse_adagrad",
+    "SparseOptimizer",
+    "sparse_optimizer",
+]
+
+
+# Out-of-bounds sentinel for padding slots: scatters to it are dropped
+# (mode="drop") and gathers clamp harmlessly.  Valid for tables < 2^31 rows;
+# larger tables use int64 ids and _OOB_ID64.
+_OOB_ID = jnp.iinfo(jnp.int32).max
+
+
+def dedupe_grads(
+    ids: jax.Array, grads: jax.Array, *, capacity: int | None = None
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Merge duplicate row ids: ``(ids[B], grads[B,D]) -> (uids[U], g[U,D], valid[U])``.
+
+    ``capacity`` is the static unique bound (defaults to ``B``).  Invalid
+    (padding) slots get an out-of-bounds sentinel id and a False mask; their
+    grad rows are zeroed and their scatters dropped, so they can never
+    collide with a real row update.
+    """
+    b = ids.shape[0]
+    capacity = capacity or b
+    raw = jnp.unique(ids, size=capacity, fill_value=-1)
+    valid = raw >= 0
+    oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
+    uids = jnp.where(valid, raw, oob)  # stays sorted: oob > every real id
+    seg = jnp.searchsorted(uids, ids)
+    g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
+    g = jnp.where(valid[:, None], g, 0.0)
+    return uids, g, valid
+
+
+def _masked_scatter_rows(table: jax.Array, uids: jax.Array, new_rows: jax.Array,
+                         valid: jax.Array) -> jax.Array:
+    """Write new_rows into table[uids]; padding slots carry an out-of-bounds
+    id and are dropped by the scatter."""
+    del valid  # encoded in uids: invalid slots are out of bounds
+    return table.at[uids].set(new_rows, mode="drop")
+
+
+def sparse_sgd(table, uids, g, valid, *, lr: float, weight_decay: float = 0.0):
+    """fbgemm EXACT_SGD parity: touched rows only, wd applied to touched rows."""
+    rows = table[uids]
+    g = g + weight_decay * rows
+    return _masked_scatter_rows(table, uids, rows - lr * g.astype(rows.dtype), valid)
+
+
+@dataclass(frozen=True)
+class _AdamHyper:
+    lr: float
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+
+
+def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
+                eps=1e-8, weight_decay=0.0):
+    """Row-sparse AdamW: moments exist per-row; bias correction uses a global
+    step count (matches fbgemm ADAM; per-row counts differ negligibly and a
+    global count is what optax uses for the dense path).
+
+    ``weight_decay`` is decoupled (AdamW) and only touches gathered rows —
+    fbgemm semantics, NOT optax's full-table decay.
+    Returns (table, mu, nu, count).
+    """
+    rows = table[uids]
+    mu_r, nu_r = mu[uids], nu[uids]
+    g = g.astype(mu_r.dtype)
+    new_count = count + 1
+    t = new_count.astype(jnp.float32)
+    mu_n = b1 * mu_r + (1 - b1) * g
+    nu_n = b2 * nu_r + (1 - b2) * g * g
+    mu_hat = mu_n / (1 - b1**t)
+    nu_hat = nu_n / (1 - b2**t)
+    delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * rows)
+    return (
+        _masked_scatter_rows(table, uids, rows - delta.astype(rows.dtype), valid),
+        _masked_scatter_rows(mu, uids, mu_n, valid),
+        _masked_scatter_rows(nu, uids, nu_n, valid),
+        new_count,
+    )
+
+
+def sparse_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10, weight_decay=0.0):
+    """fbgemm EXACT_ADAGRAD parity (row-wise accumulator of squared grads)."""
+    rows = table[uids]
+    acc_r = accum[uids]
+    g = g.astype(acc_r.dtype) + weight_decay * rows
+    acc_n = acc_r + g * g
+    delta = lr * g / (jnp.sqrt(acc_n) + eps)
+    return (
+        _masked_scatter_rows(table, uids, rows - delta.astype(rows.dtype), valid),
+        _masked_scatter_rows(accum, uids, acc_n, valid),
+    )
+
+
+@dataclass(frozen=True)
+class SparseOptimizer:
+    """Uniform wrapper: init(table)->slots, update(table, slots, ids, grads)->(table, slots).
+
+    The KeyedOptimizerWrapper/CombinedOptimizer equivalent for the sparse half
+    (``torchrec/train.py:248-254``): dense params keep optax; each embedding
+    table gets one of these.
+    """
+
+    kind: str  # "sgd" | "adam" | "adagrad"
+    lr: float
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+
+    def init(self, table: jax.Array) -> Any:
+        if self.kind == "sgd":
+            return ()
+        if self.kind == "adagrad":
+            return (jnp.zeros_like(table, dtype=jnp.float32),)
+        if self.kind == "adam":
+            return (
+                jnp.zeros_like(table, dtype=jnp.float32),
+                jnp.zeros_like(table, dtype=jnp.float32),
+                jnp.zeros((), jnp.int32),
+            )
+        raise ValueError(f"unknown sparse optimizer kind: {self.kind!r}")
+
+    def update(self, table, slots, ids, grads, *, capacity: int | None = None):
+        uids, g, valid = dedupe_grads(ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
+                                      capacity=capacity)
+        if self.kind == "sgd":
+            return sparse_sgd(table, uids, g, valid, lr=self.lr,
+                              weight_decay=self.weight_decay), slots
+        if self.kind == "adagrad":
+            (accum,) = slots
+            table, accum = sparse_adagrad(table, accum, uids, g, valid, lr=self.lr,
+                                          eps=self.eps, weight_decay=self.weight_decay)
+            return table, (accum,)
+        if self.kind == "adam":
+            mu, nu, count = slots
+            table, mu, nu, count = sparse_adam(
+                table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
+                b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+            )
+            return table, (mu, nu, count)
+        raise ValueError(self.kind)
+
+
+def sparse_optimizer(kind: str, lr: float, weight_decay: float = 0.0, **kw) -> SparseOptimizer:
+    return SparseOptimizer(kind=kind, lr=lr, weight_decay=weight_decay, **kw)

@@ -1,0 +1,304 @@
+"""ShardedEmbeddingCollection — the TPU-native DistributedModelParallel core.
+
+Re-designs torchrec's embedding stack (``EmbeddingConfig`` ->
+``EmbeddingCollection`` -> ``EmbeddingCollectionSharder`` -> ``DMP``,
+``torchrec/models.py:150-161`` + ``torchrec/train.py:235-254``) for GSPMD:
+tables are plain arrays with sharding specs on a named mesh, and the lookup is
+either compiler-scheduled (GSPMD inserts the collectives) or an explicit
+``shard_map`` program using XLA collectives over ICI — replacing NCCL
+all-to-all (SURVEY.md §2.2, §2.3).
+
+Sharding strategies (torchrec parity):
+  * ``row``        - vocab dim split over the ``model`` axis (ROW_WISE).
+  * ``column``     - embedding dim split over the ``model`` axis (COLUMN_WISE).
+  * ``table``      - whole tables placed on single model-axis slots
+                     (TABLE_WISE), expressed TPU-natively by stacking the
+                     group's tables into one row-sharded super-array whose
+                     shard boundaries coincide with table boundaries.
+  * ``replicated`` - every device holds the full table (DATA_PARALLEL).
+
+Lookup modes:
+  * ``gspmd``    - ``jnp.take`` under jit; XLA partitions the gather and
+                   inserts all-gather/all-to-all as needed.  Default; fuses
+                   with downstream compute.
+  * ``psum``     - explicit shard_map: ids replicated over ``model`` (batch
+                   sharded over ``data``), each device gathers the rows it
+                   owns, zeros elsewhere, then ``psum`` over ``model``.  One
+                   collective; the idiomatic choice when batch x model are
+                   different mesh axes.
+  * ``alltoall`` - explicit shard_map for the torchrec regime where the batch
+                   is sharded over the SAME axis as the tables: bucket ids by
+                   owner shard, ``all_to_all`` the ids, gather locally,
+                   ``all_to_all`` the vectors back (input-dist / output-dist
+                   parity with DMP's NCCL plan, ``torchrec/train.py:241-247``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tdfo_tpu.core.mesh import MODEL_AXIS
+
+__all__ = ["EmbeddingSpec", "ShardedEmbeddingCollection"]
+
+
+@dataclass(frozen=True)
+class EmbeddingSpec:
+    """torchrec ``EmbeddingConfig`` parity (torchrec/models.py:150-157)."""
+
+    name: str
+    num_embeddings: int
+    embedding_dim: int
+    features: tuple[str, ...] = ()
+    sharding: str = "row"
+    # uniform(-init_scale, init_scale); torchrec weight_init_min/max = -1/1
+    init_scale: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+
+    def feature_names(self) -> tuple[str, ...]:
+        return self.features or (self.name,)
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+class ShardedEmbeddingCollection:
+    """A set of embedding tables with mesh shardings + lookup programs.
+
+    Functional: ``init`` returns the table pytree (dict name -> array, plus
+    stacked groups), ``lookup`` maps feature ids -> vectors.  Gradients flow
+    through ``lookup`` like any jnp op; the row-sparse in-backward update path
+    lives in ``tdfo_tpu/train/sparse_step.py``.
+    """
+
+    def __init__(
+        self,
+        specs: list[EmbeddingSpec],
+        mesh: Mesh | None = None,
+        axis: str = MODEL_AXIS,
+    ):
+        self.specs = {s.name: s for s in specs}
+        if len(self.specs) != len(specs):
+            raise ValueError("duplicate table names")
+        self.mesh = mesh
+        self.axis = axis
+        self.n_shards = mesh.shape[axis] if mesh is not None else 1
+        self._feature_to_table: dict[str, str] = {}
+        for s in specs:
+            for f in s.feature_names():
+                if f in self._feature_to_table:
+                    raise ValueError(f"feature {f!r} served by two tables")
+                self._feature_to_table[f] = s.name
+
+        # table-wise groups: stack same-dim tables into one row-sharded array
+        # whose per-shard row count covers whole tables.
+        self._table_wise = [s for s in specs if s.sharding == "table"]
+        self._stack_rows: dict[str, tuple[int, int]] = {}  # name -> (group_offset, padded_rows)
+        self._groups: dict[str, list[EmbeddingSpec]] = {}
+        if self._table_wise:
+            if mesh is None:
+                raise ValueError("table-wise sharding requires a mesh")
+            by_dim: dict[int, list[EmbeddingSpec]] = {}
+            for s in self._table_wise:
+                by_dim.setdefault(s.embedding_dim, []).append(s)
+            for dim, group in by_dim.items():
+                # shard slot i holds tables i, i+M, i+2M, ...; pad every slot
+                # to the max slot height so boundaries align with shards.
+                m = self.n_shards
+                slots: list[list[EmbeddingSpec]] = [group[i::m] for i in range(m)]
+                slot_rows = max(sum(s.num_embeddings for s in sl) for sl in slots) if group else 0
+                slot_rows = max(slot_rows, 1)
+                offsets = {}
+                for i, sl in enumerate(slots):
+                    off = i * slot_rows
+                    for s in sl:
+                        offsets[s.name] = off
+                        off += s.num_embeddings
+                for s in group:
+                    self._stack_rows[s.name] = (offsets[s.name], slot_rows * m)
+                self._groups[f"__stack_{dim}"] = group
+
+    # ---------------------------------------------------------------- init
+
+    def table_sharding(self, spec: EmbeddingSpec) -> NamedSharding | None:
+        if self.mesh is None:
+            return None
+        if spec.sharding == "row":
+            return NamedSharding(self.mesh, P(self.axis, None))
+        if spec.sharding == "column":
+            return NamedSharding(self.mesh, P(None, self.axis))
+        if spec.sharding == "replicated":
+            return NamedSharding(self.mesh, P())
+        raise ValueError(spec.sharding)
+
+    def init(self, rng: jax.Array) -> dict[str, jax.Array]:
+        """Create all tables, placed with their shardings.
+
+        Row-sharded vocab sizes are padded up to a multiple of the shard
+        count (padding rows are valid storage, never referenced by real ids).
+        """
+        tables: dict[str, jax.Array] = {}
+        keys = jax.random.split(rng, len(self.specs) + len(self._groups))
+        key_iter = iter(keys)
+        for name, spec in self.specs.items():
+            if spec.sharding == "table":
+                continue
+            rows = spec.num_embeddings
+            if spec.sharding == "row":
+                rows = _round_up(rows, self.n_shards)
+            dim = spec.embedding_dim
+            if spec.sharding == "column" and dim % self.n_shards:
+                raise ValueError(
+                    f"table {name}: embedding_dim {dim} not divisible by "
+                    f"{self.n_shards} column shards"
+                )
+            t = jax.random.uniform(
+                next(key_iter), (rows, dim), spec.dtype,
+                minval=-spec.init_scale, maxval=spec.init_scale,
+            )
+            sh = self.table_sharding(spec)
+            tables[name] = jax.device_put(t, sh) if sh is not None else t
+        for gname, group in self._groups.items():
+            total = self._stack_rows[group[0].name][1]
+            dim = group[0].embedding_dim
+            t = jax.random.uniform(
+                next(key_iter), (total, dim), group[0].dtype,
+                minval=-group[0].init_scale, maxval=group[0].init_scale,
+            )
+            sh = NamedSharding(self.mesh, P(self.axis, None))
+            tables[gname] = jax.device_put(t, sh)
+        return tables
+
+    # -------------------------------------------------------------- lookup
+
+    def _resolve(self, feature: str) -> tuple[str, EmbeddingSpec, int]:
+        tname = self._feature_to_table.get(feature)
+        if tname is None:
+            raise KeyError(f"no table serves feature {feature!r}")
+        spec = self.specs[tname]
+        if spec.sharding == "table":
+            offset, _ = self._stack_rows[tname]
+            return f"__stack_{spec.embedding_dim}", spec, offset
+        return tname, spec, 0
+
+    def lookup(
+        self,
+        tables: Mapping[str, jax.Array],
+        features: Mapping[str, jax.Array],
+        mode: str = "gspmd",
+    ) -> dict[str, jax.Array]:
+        """ids -> vectors for every feature.  ids may be any shape; output
+        gains a trailing ``embedding_dim`` axis."""
+        out: dict[str, jax.Array] = {}
+        for feat, ids in features.items():
+            tname, spec, offset = self._resolve(feat)
+            table = tables[tname]
+            if mode == "gspmd" or self.mesh is None or spec.sharding in ("replicated",):
+                vecs = jnp.take(table, ids + offset, axis=0)
+                if self.mesh is not None and spec.sharding == "column":
+                    vecs = jax.lax.with_sharding_constraint(
+                        vecs, NamedSharding(self.mesh, P(*([None] * ids.ndim), self.axis))
+                    )
+            elif mode == "psum":
+                vecs = self._lookup_psum(table, ids + offset)
+            elif mode == "alltoall":
+                vecs = self._lookup_alltoall(table, ids + offset)
+            else:
+                raise ValueError(f"unknown lookup mode {mode!r}")
+            out[feat] = vecs
+        return out
+
+    def _lookup_psum(self, table: jax.Array, ids: jax.Array) -> jax.Array:
+        """Explicit row-shard lookup: ids replicated over the model axis.
+
+        Each device gathers rows it owns and zeros the rest; one ``psum``
+        over the model axis assembles full vectors.  Batch stays sharded
+        over ``data`` untouched.
+        """
+        mesh = self.mesh
+        axis = self.axis
+        rows_per_shard = table.shape[0] // self.n_shards
+
+        def local(table_shard, ids_local):
+            idx = jax.lax.axis_index(axis)
+            start = idx * rows_per_shard
+            local_ids = ids_local - start
+            mine = (local_ids >= 0) & (local_ids < rows_per_shard)
+            gathered = jnp.take(table_shard, jnp.clip(local_ids, 0, rows_per_shard - 1), axis=0)
+            gathered = jnp.where(mine[..., None], gathered, 0)
+            return jax.lax.psum(gathered, axis)
+
+        from tdfo_tpu.core.mesh import DATA_AXIS
+
+        ids_spec = P(DATA_AXIS, *([None] * (ids.ndim - 1)))
+        out_spec = P(DATA_AXIS, *([None] * ids.ndim))
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), ids_spec),
+            out_specs=out_spec,
+            check_vma=False,
+        )(table, ids)
+
+    def _lookup_alltoall(self, table: jax.Array, ids: jax.Array) -> jax.Array:
+        """torchrec input-dist/output-dist parity: batch AND table sharded
+        over the same ``model`` axis.
+
+        Per device: bucket local ids by owner shard (capacity = local batch,
+        the worst case), ``all_to_all`` id buckets, gather owned rows,
+        ``all_to_all`` vectors back, un-permute.  Two collectives per lookup,
+        both riding ICI — the GSPMD-era NCCL a2a plan.
+        """
+        if ids.ndim != 1:
+            orig_shape = ids.shape
+            flat = ids.reshape(-1)
+            out = self._lookup_alltoall(table, flat)
+            return out.reshape(*orig_shape, -1)
+
+        mesh = self.mesh
+        axis = self.axis
+        m = self.n_shards
+        rows_per_shard = table.shape[0] // m
+
+        def local(table_shard, ids_local):
+            n = ids_local.shape[0]  # local batch
+            owner = jnp.clip(ids_local // rows_per_shard, 0, m - 1)  # [n]
+            # stable sort by owner -> contiguous buckets; bucket k occupies
+            # slots [k*n, (k+1)*n) of a capacity-padded send buffer.
+            order = jnp.argsort(owner, stable=True)
+            sorted_ids = ids_local[order]
+            sorted_owner = owner[order]
+            # position within bucket
+            pos_in_bucket = jnp.arange(n) - jnp.searchsorted(sorted_owner, sorted_owner)
+            send = jnp.full((m, n), -1, ids_local.dtype)
+            send = send.at[sorted_owner, pos_in_bucket].set(sorted_ids)
+            # a2a: axis 0 is the peer dim
+            recv_ids = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)  # [m, n]
+            local_idx = recv_ids - jax.lax.axis_index(axis) * rows_per_shard
+            valid = recv_ids >= 0
+            gathered = jnp.take(
+                table_shard, jnp.clip(local_idx, 0, rows_per_shard - 1), axis=0
+            )
+            gathered = jnp.where(valid[..., None], gathered, 0)
+            # send vectors back to requesters
+            back = jax.lax.all_to_all(gathered, axis, split_axis=0, concat_axis=0)  # [m, n, D]
+            # back[k, j] answers the id this device put in bucket k slot j
+            flat = back.reshape(m * n, -1)
+            slot = sorted_owner * n + pos_in_bucket  # where each sorted id went
+            answers_sorted = jnp.take(flat, slot, axis=0)
+            inv = jnp.argsort(order, stable=True)
+            return jnp.take(answers_sorted, inv, axis=0)
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis)),
+            out_specs=P(axis),
+            check_vma=False,
+        )(table, ids)

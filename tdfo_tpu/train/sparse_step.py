@@ -1,0 +1,127 @@
+"""Hybrid sparse/dense train step — the DMP + CombinedOptimizer equivalent.
+
+torchrec splits parameters in two (``torchrec/train.py:235-254``): embedding
+tables get a fused in-backward sparse optimizer (fbgemm), dense params get a
+regular optimizer wrapped in ``CombinedOptimizer``.  The TPU-native
+re-expression:
+
+  * the step computes gradients w.r.t. the *gathered vectors* (an activation,
+    shape [B, D]) instead of the dense [V, D] table — the jnp.take VJP that
+    would materialise a dense table gradient is never taken;
+  * each table then gets a row-sparse update (``tdfo_tpu/ops/sparse``) that
+    touches O(unique ids) rows of table + optimizer slots;
+  * dense params flow through optax exactly as in the dense step.
+
+Under GSPMD with row-sharded tables the gather/scatter pair lowers to ICI
+collectives; tables, slots and updates all stay sharded end to end.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tdfo_tpu.ops.sparse import SparseOptimizer
+from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+
+__all__ = ["SparseTrainState", "make_sparse_train_step"]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SparseTrainState:
+    """Dense params under optax + embedding tables under sparse optimizers."""
+
+    step: jax.Array
+    dense_params: Any
+    opt_state: Any
+    tables: dict[str, jax.Array]
+    slots: dict[str, Any]
+    tx: optax.GradientTransformation = field(metadata=dict(static=True))
+    sparse_opt: SparseOptimizer = field(metadata=dict(static=True))
+
+    @classmethod
+    def create(cls, *, dense_params, tx, tables, sparse_opt) -> "SparseTrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            dense_params=dense_params,
+            opt_state=tx.init(dense_params),
+            tables=dict(tables),
+            slots={n: sparse_opt.init(t) for n, t in tables.items()},
+            tx=tx,
+            sparse_opt=sparse_opt,
+        )
+
+
+def make_sparse_train_step(
+    coll: ShardedEmbeddingCollection,
+    forward: Callable,
+    *,
+    mode: str = "gspmd",
+    donate: bool = True,
+):
+    """Build the jitted hybrid step.
+
+    ``forward(dense_params, embeddings, batch) -> scalar loss`` receives the
+    gathered vectors ``{feature: [**ids_shape, D]}`` — the model under this
+    step consumes embeddings as inputs (HistoryArch-style,
+    ``torchrec/models.py:163-178``) rather than owning the tables.
+
+    ``batch`` must contain an id array for every feature the collection
+    serves (same key names).
+    """
+    features = list(coll._feature_to_table)
+
+    def step(state: SparseTrainState, batch) -> tuple[SparseTrainState, jax.Array]:
+        ids = {f: batch[f] for f in features}
+
+        # Gradients w.r.t. the gathered vectors, never the [V, D] table.
+        def loss_from_embs(dense_params, embs):
+            return forward(dense_params, embs, batch)
+
+        embs = coll.lookup(state.tables, ids, mode=mode)
+        loss, (g_dense, g_embs) = jax.value_and_grad(loss_from_embs, argnums=(0, 1))(
+            state.dense_params, embs
+        )
+
+        # dense half: optax
+        updates, new_opt_state = state.tx.update(g_dense, state.opt_state, state.dense_params)
+        new_dense = optax.apply_updates(state.dense_params, updates)
+
+        # sparse half: group features by table, one row-sparse update each
+        new_tables = dict(state.tables)
+        new_slots = dict(state.slots)
+        by_table: dict[str, list[str]] = {}
+        for f in features:
+            tname, _, _ = coll._resolve(f)
+            by_table.setdefault(tname, []).append(f)
+        for tname, feats in by_table.items():
+            id_list, grad_list = [], []
+            for f in feats:
+                _, _, offset = coll._resolve(f)
+                id_list.append((ids[f] + offset).reshape(-1))
+                grad_list.append(g_embs[f].reshape(-1, g_embs[f].shape[-1]))
+            all_ids = jnp.concatenate(id_list)
+            all_grads = jnp.concatenate(grad_list)
+            new_tables[tname], new_slots[tname] = state.sparse_opt.update(
+                state.tables[tname], state.slots[tname], all_ids, all_grads
+            )
+
+        return (
+            SparseTrainState(
+                step=state.step + 1,
+                dense_params=new_dense,
+                opt_state=new_opt_state,
+                tables=new_tables,
+                slots=new_slots,
+                tx=state.tx,
+                sparse_opt=state.sparse_opt,
+            ),
+            loss,
+        )
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,150 @@
+"""ShardedEmbeddingCollection: sharded-vs-replicated exactness on the 8-dev mesh.
+
+The acceptance bar from SURVEY.md §7/#8: every sharding strategy and lookup
+mode must produce bit-identical vectors to a plain dense take.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tdfo_tpu.parallel.embedding import EmbeddingSpec, ShardedEmbeddingCollection
+
+V, D = 64, 16
+
+
+def reference_lookup(table, ids):
+    return np.asarray(table)[np.asarray(ids)]
+
+
+@pytest.fixture(scope="module")
+def ids():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.integers(0, V, 128, dtype=np.int32))
+
+
+def make_coll(mesh, sharding, **kw):
+    spec = EmbeddingSpec("item", V, D, features=("item",), sharding=sharding, **kw)
+    coll = ShardedEmbeddingCollection([spec], mesh=mesh)
+    tables = coll.init(jax.random.key(0))
+    return coll, tables
+
+
+def test_unsharded_lookup(ids):
+    coll, tables = make_coll(None, "row")
+    out = coll.lookup(tables, {"item": ids})["item"]
+    np.testing.assert_array_equal(out, reference_lookup(tables["item"], ids))
+
+
+@pytest.mark.parametrize("sharding", ["row", "column", "replicated"])
+def test_gspmd_modes_match_dense(mesh8, ids, sharding):
+    coll, tables = make_coll(mesh8, sharding)
+    out = jax.jit(lambda t, i: coll.lookup(t, {"item": i})["item"])(tables, ids)
+    np.testing.assert_array_equal(np.asarray(out), reference_lookup(tables["item"], ids))
+
+
+def test_row_table_is_actually_sharded(mesh8):
+    coll, tables = make_coll(mesh8, "row")
+    spec = tables["item"].sharding.spec
+    assert spec[0] == "model"
+    assert tables["item"].addressable_shards[0].data.shape == (V // 2, D)
+
+
+def test_psum_lookup_matches_dense(mesh8, ids):
+    coll, tables = make_coll(mesh8, "row")
+    data_sharded = jax.device_put(ids, NamedSharding(mesh8, P("data")))
+    out = jax.jit(lambda t, i: coll.lookup(t, {"item": i}, mode="psum")["item"])(
+        tables, data_sharded
+    )
+    np.testing.assert_array_equal(np.asarray(out), reference_lookup(tables["item"], ids))
+
+
+def test_psum_lookup_2d_ids(mesh8):
+    rng = np.random.default_rng(1)
+    ids2 = jnp.asarray(rng.integers(0, V, (16, 5), dtype=np.int32))
+    coll, tables = make_coll(mesh8, "row")
+    out = jax.jit(lambda t, i: coll.lookup(t, {"item": i}, mode="psum")["item"])(tables, ids2)
+    assert out.shape == (16, 5, D)
+    np.testing.assert_array_equal(np.asarray(out), reference_lookup(tables["item"], ids2))
+
+
+def test_alltoall_lookup_matches_dense(mesh8, ids):
+    coll, tables = make_coll(mesh8, "row")
+    model_sharded = jax.device_put(ids, NamedSharding(mesh8, P("model")))
+    out = jax.jit(lambda t, i: coll.lookup(t, {"item": i}, mode="alltoall")["item"])(
+        tables, model_sharded
+    )
+    np.testing.assert_array_equal(np.asarray(out), reference_lookup(tables["item"], ids))
+
+
+def test_alltoall_skewed_ids(mesh8):
+    # all ids hit one shard — worst-case bucket capacity
+    ids_skew = jnp.zeros(64, jnp.int32)
+    coll, tables = make_coll(mesh8, "row")
+    out = jax.jit(lambda t, i: coll.lookup(t, {"item": i}, mode="alltoall")["item"])(
+        tables, ids_skew
+    )
+    np.testing.assert_array_equal(np.asarray(out), reference_lookup(tables["item"], ids_skew))
+
+
+def test_gradients_flow_through_psum(mesh8, ids):
+    coll, tables = make_coll(mesh8, "row")
+
+    def loss(tables):
+        return coll.lookup(tables, {"item": ids[:8]}, mode="psum")["item"].sum()
+
+    g = jax.jit(jax.grad(loss))(tables)["item"]
+    dense = np.zeros((V, D), np.float32)
+    np.add.at(dense, np.asarray(ids[:8]), 1.0)
+    np.testing.assert_array_equal(np.asarray(g), dense)
+
+
+def test_table_wise_stacking(mesh8):
+    specs = [
+        EmbeddingSpec(f"t{i}", 10 + i, D, features=(f"f{i}",), sharding="table")
+        for i in range(4)
+    ]
+    coll = ShardedEmbeddingCollection(specs, mesh=mesh8)
+    tables = coll.init(jax.random.key(1))
+    assert "__stack_16" in tables
+    # shard boundaries: 2 model shards, slot height = max slot sum
+    stacked = tables["__stack_16"]
+    assert stacked.sharding.spec[0] == "model"
+    rng = np.random.default_rng(2)
+    feats = {f"f{i}": jnp.asarray(rng.integers(0, 10 + i, 32, dtype=np.int32)) for i in range(4)}
+    out = jax.jit(lambda t, f: coll.lookup(t, f))(tables, feats)
+    for i in range(4):
+        offset, total = coll._stack_rows[f"t{i}"]
+        want = np.asarray(stacked)[np.asarray(feats[f"f{i}"]) + offset]
+        np.testing.assert_array_equal(np.asarray(out[f"f{i}"]), want)
+
+
+def test_multi_feature_shared_table(mesh8):
+    spec = EmbeddingSpec("item", V, D, features=("hist", "target"), sharding="row")
+    coll = ShardedEmbeddingCollection([spec], mesh=mesh8)
+    tables = coll.init(jax.random.key(3))
+    out = coll.lookup(tables, {"hist": jnp.asarray([1, 2]), "target": jnp.asarray([3])})
+    assert out["hist"].shape == (2, D) and out["target"].shape == (1, D)
+
+
+def test_feature_errors(mesh8):
+    spec = EmbeddingSpec("item", V, D, features=("a",))
+    coll = ShardedEmbeddingCollection([spec], mesh=mesh8)
+    tables = coll.init(jax.random.key(0))
+    with pytest.raises(KeyError, match="nope"):
+        coll.lookup(tables, {"nope": jnp.asarray([0])})
+    with pytest.raises(ValueError, match="two tables"):
+        ShardedEmbeddingCollection(
+            [EmbeddingSpec("x", 4, 4, features=("f",)), EmbeddingSpec("y", 4, 4, features=("f",))]
+        )
+
+
+def test_vocab_padding_for_row_sharding(mesh8):
+    # 63 rows over 2 shards -> padded to 64
+    coll, tables = make_coll(mesh8, "row")
+    spec = EmbeddingSpec("odd", 63, D, features=("odd",), sharding="row")
+    c2 = ShardedEmbeddingCollection([spec], mesh=mesh8)
+    t2 = c2.init(jax.random.key(0))
+    assert t2["odd"].shape == (64, D)

@@ -36,6 +36,10 @@ def test_dense_jagged_roundtrip():
     dense = jt.to_dense(max_len=3)
     packed = dense_to_jagged(dense, jt.lengths)
     np.testing.assert_array_equal(packed[:6], [7, 8, 9, 10, 11, 12])
+    # invariant: tail slots are zeroed even when dense used a nonzero pad
+    dense_pad = jt.to_dense(max_len=3, pad_value=-1)
+    packed_pad = dense_to_jagged(dense_pad, jt.lengths)
+    np.testing.assert_array_equal(packed_pad[6:], 0)
     jt2 = JaggedTensor.from_dense(dense, jt.lengths)
     np.testing.assert_array_equal(jt2.to_dense(max_len=3), dense)
 

@@ -1,0 +1,179 @@
+"""Hybrid sparse/dense step parity tests.
+
+* SGD: row-sparse SGD is mathematically identical to dense SGD (untouched
+  rows get zero update), so the runs must match to fp tolerance.
+* Adam: sparse/lazy Adam intentionally differs from dense Adam (dense decays
+  the moments of untouched rows every step; lazy Adam — like fbgemm's fused
+  ADAM — only touches gathered rows).  Parity bar is a NumPy lazy-Adam
+  reference, plus exactness across sharding modes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tdfo_tpu.ops.sparse import sparse_optimizer
+from tdfo_tpu.parallel.embedding import EmbeddingSpec, ShardedEmbeddingCollection
+from tdfo_tpu.train.sparse_step import SparseTrainState, make_sparse_train_step
+
+V, D, B = 40, 8, 16
+
+
+def forward(dense_params, embs, batch):
+    x = embs["item"]  # [B, D]
+    logits = x @ dense_params["w"] + dense_params["b"]  # [B]
+    return optax.sigmoid_binary_cross_entropy(logits, batch["label"]).mean()
+
+
+def make_setup(mesh=None):
+    coll = ShardedEmbeddingCollection(
+        [EmbeddingSpec("item", V, D, features=("item",))], mesh=mesh
+    )
+    tables = coll.init(jax.random.key(0))
+    dense_params = {
+        "w": jnp.full((D,), 0.1, jnp.float32),
+        "b": jnp.zeros((), jnp.float32),
+    }
+    return coll, tables, dense_params
+
+
+def batches(n):
+    rng = np.random.default_rng(0)
+    for _ in range(n):
+        ids = rng.integers(0, V, B, dtype=np.int32)
+        yield {
+            "item": jnp.asarray(ids),
+            "label": jnp.asarray((ids % 2).astype(np.float32)),
+        }
+
+
+def run_sparse(n_steps=10, mesh=None, mode="gspmd", kind="adam", lr=1e-2):
+    coll, tables, dense_params = make_setup(mesh)
+    state = SparseTrainState.create(
+        dense_params=dense_params,
+        tx=optax.sgd(lr) if kind == "sgd" else optax.adam(lr),
+        tables=tables,
+        sparse_opt=sparse_optimizer(kind, lr=lr),
+    )
+    step = make_sparse_train_step(coll, forward, mode=mode, donate=False)
+    losses = []
+    for batch in batches(n_steps):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    return state, losses
+
+
+def run_dense_sgd(n_steps=10, lr=1e-2):
+    coll, tables, dense_params = make_setup(None)
+    params = {"table": tables["item"], **dense_params}
+    tx = optax.sgd(lr)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        def loss_fn(p):
+            embs = {"item": jnp.take(p["table"], batch["item"], axis=0)}
+            return forward({"w": p["w"], "b": p["b"]}, embs, batch)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(g, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for batch in batches(n_steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+        losses.append(float(loss))
+    return params, losses
+
+
+def lazy_adam_reference(n_steps, lr=1e-2, b1=0.9, b2=0.999, eps=1e-8):
+    """NumPy lazy-Adam on the table; full Adam on dense params (they're
+    touched every step, so lazy == dense for them)."""
+    coll, tables, dense_params = make_setup(None)
+    table = np.asarray(tables["item"], np.float64)
+    w = np.asarray(dense_params["w"], np.float64)
+    b = float(dense_params["b"])
+    m_t, v_t = np.zeros_like(table), np.zeros_like(table)
+    m_w, v_w = np.zeros_like(w), np.zeros_like(w)
+    m_b = v_b = 0.0
+    losses = []
+    t = 0
+    for batch in batches(n_steps):
+        ids = np.asarray(batch["item"])
+        y = np.asarray(batch["label"], np.float64)
+        x = table[ids]
+        logits = x @ w + b
+        p = 1.0 / (1.0 + np.exp(-logits))
+        losses.append(float(np.mean(
+            np.logaddexp(0, logits) - y * logits
+        )))
+        dlogits = (p - y) / B
+        gw = x.T @ dlogits
+        gb = dlogits.sum()
+        gx = np.outer(dlogits, w)
+        gtab = np.zeros_like(table)
+        np.add.at(gtab, ids, gx)
+        t += 1
+        c1, c2 = 1 - b1**t, 1 - b2**t
+        touched = np.unique(ids)
+        m_t[touched] = b1 * m_t[touched] + (1 - b1) * gtab[touched]
+        v_t[touched] = b2 * v_t[touched] + (1 - b2) * gtab[touched] ** 2
+        table[touched] -= lr * (m_t[touched] / c1) / (np.sqrt(v_t[touched] / c2) + eps)
+        m_w = b1 * m_w + (1 - b1) * gw
+        v_w = b2 * v_w + (1 - b2) * gw**2
+        w -= lr * (m_w / c1) / (np.sqrt(v_w / c2) + eps)
+        m_b = b1 * m_b + (1 - b1) * gb
+        v_b = b2 * v_b + (1 - b2) * gb**2
+        b -= lr * (m_b / c1) / (np.sqrt(v_b / c2) + eps)
+    return table, losses
+
+
+def test_sparse_sgd_matches_dense_sgd():
+    state, sparse_losses = run_sparse(10, kind="sgd")
+    params, dense_losses = run_dense_sgd(10)
+    np.testing.assert_allclose(sparse_losses, dense_losses, rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state.tables["item"]), np.asarray(params["table"]), rtol=1e-5, atol=1e-7
+    )
+
+
+def test_sparse_adam_matches_lazy_adam_reference():
+    state, sparse_losses = run_sparse(10, kind="adam")
+    table_ref, ref_losses = lazy_adam_reference(10)
+    np.testing.assert_allclose(sparse_losses, ref_losses, rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(state.tables["item"]), table_ref, rtol=1e-4, atol=1e-6
+    )
+
+
+def test_sparse_step_loss_decreases():
+    # overfit one fixed batch
+    coll, tables, dense_params = make_setup()
+    state = SparseTrainState.create(
+        dense_params=dense_params, tx=optax.adam(1e-2), tables=tables,
+        sparse_opt=sparse_optimizer("adam", lr=1e-2),
+    )
+    step = make_sparse_train_step(coll, forward, donate=False)
+    batch = next(iter(batches(1)))
+    losses = []
+    for _ in range(80):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_sharded_matches_unsharded(mesh8):
+    _, base = run_sparse(8, mesh=None)
+    state, gspmd = run_sparse(8, mesh=mesh8, mode="gspmd")
+    _, psum = run_sparse(8, mesh=mesh8, mode="psum")
+    np.testing.assert_allclose(gspmd, base, rtol=1e-5)
+    np.testing.assert_allclose(psum, base, rtol=1e-5)
+    # tables remain row-sharded after updates
+    assert state.tables["item"].sharding.spec[0] == "model"
+
+
+def test_step_counter_and_slots():
+    state, _ = run_sparse(5)
+    assert int(state.step) == 5
+    assert int(state.slots["item"][2]) == 5  # adam count advanced
