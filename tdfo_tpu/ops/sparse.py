@@ -37,29 +37,26 @@ __all__ = [
 ]
 
 
-# Out-of-bounds sentinel for padding slots: scatters to it are dropped
-# (mode="drop") and gathers clamp harmlessly.  Valid for tables < 2^31 rows;
-# larger tables use int64 ids and _OOB_ID64.
-_OOB_ID = jnp.iinfo(jnp.int32).max
-
-
 def dedupe_grads(
     ids: jax.Array, grads: jax.Array, *, capacity: int | None = None
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Merge duplicate row ids: ``(ids[B], grads[B,D]) -> (uids[U], g[U,D], valid[U])``.
 
-    ``capacity`` is the static unique bound (defaults to ``B``).  Invalid
-    (padding) slots get an out-of-bounds sentinel id and a False mask; their
-    grad rows are zeroed and their scatters dropped, so they can never
-    collide with a real row update.
+    ``capacity`` is the static unique bound (defaults to ``B``).  Negative
+    (padding) ids are remapped to an out-of-bounds sentinel *before* the
+    unique so sortedness holds for the searchsorted below; sentinel slots get
+    a False mask, zeroed grad rows, and their scatters dropped (mode="drop"),
+    so they can never collide with a real row update.  The sentinel is the
+    id dtype's max, which must not be a real row id (tables are < 2^31 rows
+    for int32 ids).
     """
     b = ids.shape[0]
     capacity = capacity or b
-    raw = jnp.unique(ids, size=capacity, fill_value=-1)
-    valid = raw >= 0
     oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
-    uids = jnp.where(valid, raw, oob)  # stays sorted: oob > every real id
-    seg = jnp.searchsorted(uids, ids)
+    clean = jnp.where(ids >= 0, ids, oob)
+    uids = jnp.unique(clean, size=capacity, fill_value=oob)  # sorted, oob last
+    valid = uids < oob
+    seg = jnp.searchsorted(uids, clean)
     g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
     g = jnp.where(valid[:, None], g, 0.0)
     return uids, g, valid
@@ -78,15 +75,6 @@ def sparse_sgd(table, uids, g, valid, *, lr: float, weight_decay: float = 0.0):
     rows = table[uids]
     g = g + weight_decay * rows
     return _masked_scatter_rows(table, uids, rows - lr * g.astype(rows.dtype), valid)
-
-
-@dataclass(frozen=True)
-class _AdamHyper:
-    lr: float
-    b1: float = 0.9
-    b2: float = 0.999
-    eps: float = 1e-8
-    weight_decay: float = 0.0
 
 
 def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,

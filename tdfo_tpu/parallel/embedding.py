@@ -108,6 +108,11 @@ class ShardedEmbeddingCollection:
             for s in self._table_wise:
                 by_dim.setdefault(s.embedding_dim, []).append(s)
             for dim, group in by_dim.items():
+                if len({(s.dtype, s.init_scale) for s in group}) > 1:
+                    raise ValueError(
+                        "table-wise tables stacked into one array must share "
+                        f"dtype and init_scale; got {[(s.name, s.dtype, s.init_scale) for s in group]}"
+                    )
                 # shard slot i holds tables i, i+M, i+2M, ...; pad every slot
                 # to the max slot height so boundaries align with shards.
                 m = self.n_shards
@@ -177,7 +182,19 @@ class ShardedEmbeddingCollection:
 
     # -------------------------------------------------------------- lookup
 
-    def _resolve(self, feature: str) -> tuple[str, EmbeddingSpec, int]:
+    def features(self) -> tuple[str, ...]:
+        """All feature names served by this collection (public contract for
+        train steps that split sparse/dense params)."""
+        return tuple(self._feature_to_table)
+
+    def resolve(self, feature: str) -> tuple[str, EmbeddingSpec, int]:
+        """Map a feature name to ``(array_name, spec, row_offset)``.
+
+        ``array_name`` is the key into the ``init()`` pytree (a stacked group
+        array for table-wise specs) and ``row_offset`` the feature's base row
+        within it.  Public API: the sparse-optimizer step and checkpoint
+        tooling depend on it.
+        """
         tname = self._feature_to_table.get(feature)
         if tname is None:
             raise KeyError(f"no table serves feature {feature!r}")
@@ -186,6 +203,9 @@ class ShardedEmbeddingCollection:
             offset, _ = self._stack_rows[tname]
             return f"__stack_{spec.embedding_dim}", spec, offset
         return tname, spec, 0
+
+    # backward-compat alias; prefer resolve()
+    _resolve = resolve
 
     def lookup(
         self,
@@ -197,7 +217,7 @@ class ShardedEmbeddingCollection:
         gains a trailing ``embedding_dim`` axis."""
         out: dict[str, jax.Array] = {}
         for feat, ids in features.items():
-            tname, spec, offset = self._resolve(feat)
+            tname, spec, offset = self.resolve(feat)
             table = tables[tname]
             if mode == "gspmd" or self.mesh is None or spec.sharding in ("replicated",):
                 vecs = jnp.take(table, ids + offset, axis=0)
@@ -205,10 +225,18 @@ class ShardedEmbeddingCollection:
                     vecs = jax.lax.with_sharding_constraint(
                         vecs, NamedSharding(self.mesh, P(*([None] * ids.ndim), self.axis))
                     )
-            elif mode == "psum":
-                vecs = self._lookup_psum(table, ids + offset)
-            elif mode == "alltoall":
-                vecs = self._lookup_alltoall(table, ids + offset)
+            elif mode in ("psum", "alltoall"):
+                # explicit-collective programs assume row-contiguous shards;
+                # column-sharded tables would silently reshard every step.
+                if spec.sharding not in ("row", "table"):
+                    raise ValueError(
+                        f"lookup mode {mode!r} requires row/table sharding, "
+                        f"but table {spec.name!r} is {spec.sharding!r}"
+                    )
+                if mode == "psum":
+                    vecs = self._lookup_psum(table, ids + offset)
+                else:
+                    vecs = self._lookup_alltoall(table, ids + offset)
             else:
                 raise ValueError(f"unknown lookup mode {mode!r}")
             out[feat] = vecs

@@ -74,7 +74,7 @@ def make_sparse_train_step(
     ``batch`` must contain an id array for every feature the collection
     serves (same key names).
     """
-    features = list(coll._feature_to_table)
+    features = list(coll.features())
 
     def step(state: SparseTrainState, batch) -> tuple[SparseTrainState, jax.Array]:
         ids = {f: batch[f] for f in features}
@@ -97,12 +97,12 @@ def make_sparse_train_step(
         new_slots = dict(state.slots)
         by_table: dict[str, list[str]] = {}
         for f in features:
-            tname, _, _ = coll._resolve(f)
+            tname, _, _ = coll.resolve(f)
             by_table.setdefault(tname, []).append(f)
         for tname, feats in by_table.items():
             id_list, grad_list = [], []
             for f in feats:
-                _, _, offset = coll._resolve(f)
+                _, _, offset = coll.resolve(f)
                 id_list.append((ids[f] + offset).reshape(-1))
                 grad_list.append(g_embs[f].reshape(-1, g_embs[f].shape[-1]))
             all_ids = jnp.concatenate(id_list)

@@ -148,3 +148,25 @@ def test_vocab_padding_for_row_sharding(mesh8):
     c2 = ShardedEmbeddingCollection([spec], mesh=mesh8)
     t2 = c2.init(jax.random.key(0))
     assert t2["odd"].shape == (64, D)
+
+
+def test_explicit_modes_reject_column_sharding(mesh8):
+    coll = ShardedEmbeddingCollection(
+        [EmbeddingSpec("t", 64, 8, sharding="column")], mesh=mesh8
+    )
+    tables = coll.init(jax.random.key(0))
+    ids = {"t": jnp.arange(8, dtype=jnp.int32)}
+    for mode in ("psum", "alltoall"):
+        with pytest.raises(ValueError, match="requires row/table sharding"):
+            coll.lookup(tables, ids, mode=mode)
+
+
+def test_table_wise_heterogeneous_group_rejected(mesh8):
+    with pytest.raises(ValueError, match="share\ndtype and init_scale|share "):
+        ShardedEmbeddingCollection(
+            [
+                EmbeddingSpec("a", 32, 8, sharding="table", init_scale=1.0),
+                EmbeddingSpec("b", 32, 8, sharding="table", init_scale=0.01),
+            ],
+            mesh=mesh8,
+        )

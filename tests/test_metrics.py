@@ -1,0 +1,126 @@
+"""Metrics: exact AUC vs brute force, streaming AUC vs exact, Recall/NDCG golden."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tdfo_tpu.train.metrics import AUC, binary_auc, recalls_and_ndcgs_for_ks
+
+
+def _brute_auc(labels, scores):
+    pos = scores[labels > 0.5]
+    neg = scores[labels <= 0.5]
+    wins = (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    return wins / (len(pos) * len(neg))
+
+
+class TestBinaryAUC:
+    def test_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 500).astype(np.float32)
+        scores = rng.random(500)
+        assert binary_auc(labels, scores) == pytest.approx(_brute_auc(labels, scores))
+
+    def test_ties(self):
+        labels = np.array([1, 0, 1, 0], np.float32)
+        scores = np.array([0.5, 0.5, 0.5, 0.5])
+        assert binary_auc(labels, scores) == pytest.approx(0.5)
+
+    def test_perfect_and_inverted(self):
+        labels = np.array([1, 1, 0, 0], np.float32)
+        assert binary_auc(labels, np.array([0.9, 0.8, 0.2, 0.1])) == 1.0
+        assert binary_auc(labels, np.array([0.1, 0.2, 0.8, 0.9])) == 0.0
+
+    def test_weights_mask_padding(self):
+        labels = np.array([1, 0, 1, 1], np.float32)
+        scores = np.array([0.9, 0.1, 0.0, 0.0])
+        w = np.array([1, 1, 0, 0], np.float32)  # last two rows are padding
+        assert binary_auc(labels, scores, w) == 1.0
+
+    def test_degenerate_single_class(self):
+        assert np.isnan(binary_auc(np.ones(4), np.random.rand(4)))
+
+
+class TestStreamingAUC:
+    def test_close_to_exact(self):
+        rng = np.random.default_rng(1)
+        n = 4000
+        labels = rng.integers(0, 2, n).astype(np.float32)
+        # separable-ish scores so AUC is away from 0.5
+        scores = np.clip(labels * 0.3 + rng.random(n) * 0.7, 0, 1)
+        exact = binary_auc(labels, scores)
+        state = AUC.empty(400)
+        for i in range(0, n, 1000):  # streaming in chunks
+            state = state.update(jnp.asarray(labels[i : i + 1000]), jnp.asarray(scores[i : i + 1000]))
+        assert float(state.result()) == pytest.approx(exact, abs=5e-3)
+
+    def test_update_under_jit_and_merge(self):
+        upd = jax.jit(lambda s, l, x: s.update(l, x))
+        labels = jnp.array([1.0, 0.0, 1.0, 0.0])
+        scores = jnp.array([0.9, 0.1, 0.8, 0.2])
+        a = upd(AUC.empty(100), labels[:2], scores[:2])
+        b = upd(AUC.empty(100), labels[2:], scores[2:])
+        merged = a.merge(b)
+        whole = AUC.empty(100).update(labels, scores)
+        assert float(merged.result()) == pytest.approx(float(whole.result()))
+        assert float(whole.result()) == pytest.approx(1.0)
+
+    def test_weights(self):
+        state = AUC.empty(100).update(
+            jnp.array([1.0, 0.0, 1.0]), jnp.array([0.9, 0.1, 0.0]), jnp.array([1.0, 1.0, 0.0])
+        )
+        assert float(state.result()) == pytest.approx(1.0)
+
+    def test_empty_is_nan(self):
+        assert np.isnan(float(AUC.empty().result()))
+
+
+class TestRankingMetrics:
+    def test_single_positive_golden(self):
+        # positive at candidate 0; rank it 2nd (one negative above)
+        scores = jnp.array([[0.8, 0.9, 0.1, 0.2, 0.3]])
+        labels = jnp.array([[1.0, 0.0, 0.0, 0.0, 0.0]])
+        m = recalls_and_ndcgs_for_ks(scores, labels, ks=(1, 2))
+        assert float(m["Recall@1"]) == 0.0
+        assert float(m["Recall@2"]) == 1.0
+        assert float(m["NDCG@2"]) == pytest.approx(1.0 / np.log2(3.0))
+
+    def test_perfect_ranking(self):
+        scores = jnp.array([[0.9, 0.1, 0.2], [0.8, 0.05, 0.01]])
+        labels = jnp.array([[1.0, 0.0, 0.0], [1.0, 0.0, 0.0]])
+        m = recalls_and_ndcgs_for_ks(scores, labels, ks=(1,))
+        assert float(m["Recall@1"]) == 1.0
+        assert float(m["NDCG@1"]) == pytest.approx(1.0)
+
+    def test_torchrec_protocol_shape(self):
+        # 1 positive + 100 negatives, the reference's eval protocol
+        rng = np.random.default_rng(2)
+        b = 32
+        scores = jnp.asarray(rng.random((b, 101), dtype=np.float32))
+        labels = jnp.zeros((b, 101)).at[:, 0].set(1.0)
+        m = recalls_and_ndcgs_for_ks(scores, labels, ks=(10, 20, 50))
+        assert set(m) == {"Recall@10", "Recall@20", "Recall@50", "NDCG@10", "NDCG@20", "NDCG@50"}
+        # random scores: E[Recall@k] = k/101
+        assert 0.0 <= float(m["Recall@10"]) <= 1.0
+        assert float(m["Recall@10"]) <= float(m["Recall@20"]) <= float(m["Recall@50"])
+
+    def test_multiple_positives(self):
+        scores = jnp.array([[0.9, 0.8, 0.1, 0.2]])
+        labels = jnp.array([[1.0, 1.0, 0.0, 0.0]])
+        m = recalls_and_ndcgs_for_ks(scores, labels, ks=(1, 2))
+        # Recall@1 = hits/min(1, 2 pos) = 1/1
+        assert float(m["Recall@1"]) == 1.0
+        assert float(m["Recall@2"]) == 1.0
+        assert float(m["NDCG@2"]) == pytest.approx(1.0)
+
+    def test_row_weights(self):
+        scores = jnp.array([[0.9, 0.1], [0.1, 0.9]])
+        labels = jnp.array([[1.0, 0.0], [1.0, 0.0]])
+        m = recalls_and_ndcgs_for_ks(scores, labels, ks=(1,), row_weights=jnp.array([1.0, 0.0]))
+        assert float(m["Recall@1"]) == 1.0  # padded failing row ignored
+
+    def test_under_jit(self):
+        f = jax.jit(lambda s, l: recalls_and_ndcgs_for_ks(s, l, ks=(2,)))
+        m = f(jnp.array([[0.9, 0.1, 0.5]]), jnp.array([[1.0, 0.0, 0.0]]))
+        assert float(m["Recall@2"]) == 1.0

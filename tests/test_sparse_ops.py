@@ -131,3 +131,24 @@ def test_optimizer_wrapper_roundtrip(kind):
     )
     assert float(new_table[3, 0]) < 1.0
     assert float(new_table[0, 0]) == 1.0
+
+
+def test_dedupe_negative_padding_ids_do_not_corrupt():
+    # regression: -1 padding used to break searchsorted's sortedness invariant
+    ids = jnp.array([-1, 5, 5, 7], jnp.int32)
+    grads = jnp.ones((4, 3), jnp.float32)
+    uids, g, valid = dedupe_grads(ids, grads)
+    table = jnp.zeros((10, 3), jnp.float32)
+    out = sparse_sgd(table, uids, g, valid, lr=1.0)
+    np.testing.assert_allclose(out[5], -2.0 * np.ones(3))  # two grads merged
+    np.testing.assert_allclose(out[7], -1.0 * np.ones(3))
+    assert np.all(np.asarray(out[jnp.array([0, 1, 2, 3, 4, 6, 8, 9])]) == 0)
+
+
+def test_dedupe_all_padding():
+    ids = jnp.full((4,), -1, jnp.int32)
+    uids, g, valid = dedupe_grads(ids, jnp.ones((4, 2)))
+    assert not bool(valid.any())
+    table = jnp.zeros((5, 2))
+    out = sparse_sgd(table, uids, g, valid, lr=1.0)
+    assert np.all(np.asarray(out) == 0)
