@@ -1,0 +1,317 @@
+"""Streaming data loading: parquet shards -> shuffled, host-sharded, device-fed
+batches.
+
+Unifies the reference's three loading stacks (HF iterable datasets with a 2M
+shuffle buffer, ``jax-flax/train_dp.py:94-136``; ``tf.data`` with
+shuffle/prefetch/AUTOTUNE, ``tensorflow2/data.py:134-210``; torchrec's
+``split_dataset_by_node`` DataLoader, ``torchrec/data.py:13-49``) into one
+pyarrow-native pipeline with no per-row Python:
+
+  * :class:`ParquetStream` — record-batch streaming with a block shuffle
+    buffer (each row emitted exactly once per epoch; mixing radius =
+    ``buffer_size``), per-host sharding (files round-robin when there are
+    enough files, else strided batch slices — ``split_dataset_by_node``
+    parity), epoch reseeding (``set_epoch`` parity), and ``drop_last`` for
+    static shapes (``jax-flax/train_dp.py:111-114`` rationale: ragged final
+    batches would retrigger XLA compilation).
+  * :func:`load_parquet_table` / :func:`permutation_batches` — the map-style
+    full-permutation loader (``jax-flax/train.py:52-70`` parity).
+  * :func:`prefetch_to_mesh` — double-buffered host->HBM transfer onto a
+    named mesh (``flax.jax_utils.prefetch_to_device`` parity,
+    ``jax-flax/train_dp.py:211``), multihost-aware via
+    ``jax.make_array_from_process_local_data``.
+
+List-typed columns (Bert4Rec windows) are stacked into dense [B, T] arrays at
+the arrow level.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+from pathlib import Path
+from typing import Iterator, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+__all__ = [
+    "ParquetStream",
+    "load_parquet_table",
+    "permutation_batches",
+    "prefetch_to_mesh",
+]
+
+
+def _to_numpy_columns(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
+    """Arrow -> dict of numpy; fixed-width list columns become [B, T] arrays."""
+    out: dict[str, np.ndarray] = {}
+    for name, col in zip(batch.schema.names, batch.columns):
+        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
+            arr = col.combine_chunks() if isinstance(col, pa.ChunkedArray) else col
+            flat = arr.flatten().to_numpy(zero_copy_only=False)
+            offsets = arr.offsets.to_numpy(zero_copy_only=False)
+            widths = np.diff(offsets)
+            if len(widths) and (widths != widths[0]).any():
+                raise ValueError(
+                    f"list column {name!r} is ragged; pad it in preprocessing"
+                )
+            t = int(widths[0]) if len(widths) else 0
+            out[name] = flat.reshape(len(arr), t)
+        else:
+            out[name] = col.to_numpy(zero_copy_only=False)
+    return out
+
+
+def _concat_rows(parts: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    return {k: np.concatenate([p[k] for p in parts]) for k in parts[0]}
+
+
+def _take(d: dict[str, np.ndarray], idx) -> dict[str, np.ndarray]:
+    return {k: v[idx] for k, v in d.items()}
+
+
+def resolve_files(data_dir: str | Path, pattern: str) -> list[str]:
+    files = sorted(_glob.glob(str(Path(data_dir) / pattern)))
+    if not files:
+        raise FileNotFoundError(f"no parquet files match {pattern!r} in {data_dir}")
+    return files
+
+
+class ParquetStream:
+    """Streaming shuffled batches from parquet shards.
+
+    Each epoch yields every (host-local) row exactly once, in an order
+    randomised by (seed, epoch): file order is permuted, then rows pass
+    through a ``buffer_size``-row block shuffle.  With ``drop_last`` the
+    ragged tail batch is dropped (train); otherwise it is emitted short
+    (eval, to be padded by the caller).
+    """
+
+    def __init__(
+        self,
+        files: Sequence[str],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        buffer_size: int = 2_000_000,  # jax-flax/train_dp.py:129 default
+        seed: int = 42,
+        drop_last: bool = True,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        columns: Sequence[str] | None = None,
+    ):
+        import jax
+
+        self.files = list(files)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.buffer_size = int(buffer_size)
+        self.seed = seed
+        self.drop_last = drop_last
+        self.columns = list(columns) if columns is not None else None
+        self._epoch = 0
+        self.process_index = (
+            jax.process_index() if process_index is None else process_index
+        )
+        self.process_count = (
+            jax.process_count() if process_count is None else process_count
+        )
+        # split_dataset_by_node parity (torchrec/data.py:58): whole files per
+        # host when they divide evenly, else strided row-block sharding.
+        self._shard_by_file = (
+            self.process_count > 1 and len(self.files) % self.process_count == 0
+        )
+
+    def _batches_per_host(self) -> int | None:
+        """Cross-host batch budget from parquet metadata (no communication).
+
+        Hosts MUST run the same number of batches per epoch or the first
+        collective after the shortest host's last batch deadlocks the mesh
+        (SURVEY.md §7 hard part #4).  Row counts come from file footers, so
+        every host computes the same minimum independently."""
+        if self.process_count <= 1:
+            return None
+        if self._shard_by_file:
+            rows = [
+                sum(
+                    pq.ParquetFile(f).metadata.num_rows
+                    for f in self.files[r :: self.process_count]
+                )
+                for r in range(self.process_count)
+            ]
+            min_rows = min(rows)
+        else:
+            # strided: rank r owns global rows g with g % P == r_assigned;
+            # the smallest share is floor(N / P).
+            n = sum(pq.ParquetFile(f).metadata.num_rows for f in self.files)
+            min_rows = n // self.process_count
+        return min_rows // self.batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reshuffle order for a new epoch (HF ``set_epoch`` parity,
+        ``jax-flax/train.py:143``)."""
+        self._epoch = int(epoch)
+
+    def max_batches_per_host(self) -> int:
+        """The LARGEST per-host batch count this epoch (ceil division, no
+        drop_last) — the eval-loop budget: every host must run this many step
+        calls, topping up with zero-weight padding batches, or the mesh
+        deadlocks (same invariant as :meth:`_batches_per_host`, opposite
+        rounding)."""
+        counts = []
+        for r in range(max(self.process_count, 1)):
+            if self._shard_by_file:
+                rows = sum(
+                    pq.ParquetFile(f).metadata.num_rows
+                    for f in self.files[r :: self.process_count]
+                )
+            else:
+                n = sum(pq.ParquetFile(f).metadata.num_rows for f in self.files)
+                p = max(self.process_count, 1)
+                rows = (n - r + p - 1) // p
+            counts.append(-(-rows // self.batch_size))
+        return max(counts)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        budget = self._batches_per_host() if self.drop_last else None
+        emitted = 0
+        for batch in self._iter_unbounded():
+            if budget is not None and emitted >= budget:
+                return
+            emitted += 1
+            yield batch
+
+    def _iter_unbounded(self) -> Iterator[dict[str, np.ndarray]]:
+        rng = np.random.default_rng((self.seed, self._epoch))
+        files = list(self.files)
+        if self._shard_by_file:
+            files = files[self.process_index :: self.process_count]
+        if self.shuffle:
+            rng.shuffle(files)
+
+        def raw_batches():
+            stride_pos = 0
+            for f in files:
+                pf = pq.ParquetFile(f)
+                for rb in pf.iter_batches(batch_size=65536, columns=self.columns):
+                    d = _to_numpy_columns(rb)
+                    if not self._shard_by_file and self.process_count > 1:
+                        # strided slice so every host sees a disjoint subset
+                        n = len(next(iter(d.values())))
+                        idx = np.arange(
+                            (self.process_index - stride_pos) % self.process_count,
+                            n,
+                            self.process_count,
+                        )
+                        stride_pos = (stride_pos + n) % self.process_count
+                        d = _take(d, idx)
+                    yield d
+
+        pool: list[dict[str, np.ndarray]] = []
+        pooled = 0
+        pending: list[dict[str, np.ndarray]] = []
+        pend_n = 0
+
+        def emit(d):
+            nonlocal pending, pend_n
+            pending.append(d)
+            pend_n += len(next(iter(d.values())))
+            while pend_n >= self.batch_size:
+                rows = _concat_rows(pending)
+                n = len(next(iter(rows.values())))
+                yield _take(rows, slice(0, self.batch_size))
+                rest = _take(rows, slice(self.batch_size, n))
+                pending = [rest]
+                pend_n = n - self.batch_size
+
+        for d in raw_batches():
+            if not self.shuffle:
+                yield from emit(d)
+                continue
+            pool.append(d)
+            pooled += len(next(iter(d.values())))
+            if pooled >= self.buffer_size:
+                rows = _concat_rows(pool)
+                perm = rng.permutation(pooled)
+                half = pooled // 2  # emit half, keep half for further mixing
+                yield from emit(_take(rows, perm[:half]))
+                pool = [_take(rows, perm[half:])]
+                pooled -= half
+        if pool:
+            rows = _concat_rows(pool)
+            yield from emit(_take(rows, rng.permutation(pooled)))
+        if pend_n and not self.drop_last:
+            yield _concat_rows(pending)
+
+
+def count_rows(files: Sequence[str]) -> int:
+    """Total row count from parquet metadata without reading data
+    (``get_data_size`` parity, ``jax-flax/utils.py:36-38``)."""
+    return sum(pq.ParquetFile(f).metadata.num_rows for f in files)
+
+
+def load_parquet_table(files: Sequence[str],
+                       columns: Sequence[str] | None = None) -> dict[str, np.ndarray]:
+    """Map-style: read everything into memory (``jax-flax/train.py:52-60``)."""
+    tables = [pq.read_table(f, columns=list(columns) if columns else None) for f in files]
+    return _to_numpy_columns(pa.concat_tables(tables).combine_chunks())
+
+
+def permutation_batches(
+    data: dict[str, np.ndarray],
+    batch_size: int,
+    *,
+    shuffle: bool = True,
+    seed: int = 42,
+    epoch: int = 0,
+    drop_last: bool = True,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Full-permutation epoch over an in-memory table
+    (``jax-flax/train.py:52-70`` parity)."""
+    n = len(next(iter(data.values())))
+    idx = np.arange(n)
+    if shuffle:
+        np.random.default_rng((seed, epoch)).shuffle(idx)
+    end = n - n % batch_size if drop_last else n
+    for i in range(0, end, batch_size):
+        yield _take(data, idx[i : i + batch_size])
+
+
+def prefetch_to_mesh(it, mesh, pspec=None, *, size: int = 2):
+    """Double-buffered host->device transfer onto a mesh.
+
+    ``jax-flax/train_dp.py:210-211`` parity (shard + prefetch_to_device(2)):
+    keeps ``size`` batches in flight; jax dispatches transfers asynchronously
+    so compute overlaps the next batch's copy.  Multihost: each host provides
+    its local rows via ``make_array_from_process_local_data``.
+    """
+    import collections
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(mesh, pspec if pspec is not None else P("data"))
+
+    def put(batch):
+        if jax.process_count() > 1:
+            return {
+                k: jax.make_array_from_process_local_data(sharding, v)
+                for k, v in batch.items()
+            }
+        return jax.device_put(batch, sharding)
+
+    q = collections.deque()
+    it = iter(it)
+    try:
+        for _ in range(size):
+            q.append(put(next(it)))
+    except StopIteration:
+        pass
+    while q:
+        b = q.popleft()
+        try:
+            q.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield b

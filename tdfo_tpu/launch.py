@@ -1,0 +1,95 @@
+"""Single entry point: ``python -m tdfo_tpu.launch --config config.toml``.
+
+Replaces the reference's per-backend script zoo (``python train.py`` /
+``train_dp.py`` / ``train_ps.py``; ``torchx run ... dist.ddp -j 1x2``,
+``torchrec/README.md:56``).  On a TPU pod every host runs this same command;
+``jax.distributed.initialize()`` discovers peers from the TPU environment —
+no TF_CONFIG / cluster.json / torchx env plumbing (SURVEY.md §5.6).
+
+Subcommands:
+  * ``train`` (default)      — build the Trainer from config and fit.
+  * ``preprocess-ctr``       — TwoTower ETL (jax-flax/preprocessing parity).
+  * ``preprocess-seq``       — Bert4Rec ETL (torchrec/preprocessing parity).
+  * ``synth``                — write a synthetic raw-goodreads fixture.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _init_distributed(flag: str) -> None:
+    import jax
+
+    if flag == "never":
+        return
+    try:
+        jax.distributed.initialize()
+    except Exception as e:  # single-process runs have no coordinator
+        if flag == "always":
+            raise
+        print(f"single-process run (jax.distributed not initialised: {e})")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
+    p.add_argument("command", nargs="?", default="train",
+                   choices=["train", "preprocess-ctr", "preprocess-seq", "synth"])
+    p.add_argument("--config", default="config.toml", help="path to config.toml")
+    p.add_argument("--data-dir", default=None, help="override config data_dir")
+    p.add_argument("--distributed", default="auto", choices=["auto", "always", "never"],
+                   help="jax.distributed.initialize policy (multi-host pods)")
+    p.add_argument("--log-dir", default=None)
+    args = p.parse_args(argv)
+
+    from tdfo_tpu.core.config import read_configs
+
+    overrides = {}
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    cfg = read_configs(args.config, **overrides)
+
+    if args.command == "synth":
+        from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+
+        write_synthetic_goodreads(cfg.data_dir)
+        print(f"synthetic goodreads raw files written to {cfg.data_dir}")
+        return 0
+    if args.command == "preprocess-ctr":
+        from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+
+        size_map = run_ctr_preprocessing(cfg.data_dir, seed=cfg.seed)
+        print(f"size_map: {size_map}")
+        return 0
+    if args.command == "preprocess-seq":
+        from tdfo_tpu.data.seq_preprocessing import run_seq_preprocessing
+
+        stats = run_seq_preprocessing(
+            cfg.data_dir, max_len=cfg.max_len, sliding_step=cfg.sliding_step,
+            mask_prob=cfg.mask_prob, seed=cfg.seed,
+        )
+        print(f"seq preprocessing: {stats}")
+        return 0
+
+    _init_distributed(args.distributed)
+
+    if cfg.model == "bert4rec":
+        # bert4rec has its OWN handshake file with remapped 1-based ids
+        # (torchrec parity); the CTR size_map.json that read_configs auto-merges
+        # counts the full catalog and would mis-size the mask token.
+        import json
+        from pathlib import Path
+
+        alt = Path(cfg.data_dir) / "size_map_bert4rec.json"
+        if alt.exists():
+            cfg = cfg.replace(size_map=json.loads(alt.read_text()))
+    from tdfo_tpu.train.trainer import Trainer
+
+    metrics = Trainer(cfg, log_dir=args.log_dir).fit()
+    print({k: round(v, 5) for k, v in metrics.items()})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

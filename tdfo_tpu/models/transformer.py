@@ -1,0 +1,107 @@
+"""Transformer blocks — flax, TPU-first.
+
+Behavioral parity with the reference's hand-rolled torch stack
+(``torchrec/models.py:11-129``: scaled dot-product attention with a boolean
+mask driven to -1e9, multi-head projection, position-wise feed-forward,
+pre-norm residual sublayers).  TPU-first departures:
+
+  * QKV is one fused ``Dense(3*dim)`` matmul (one big MXU op instead of three
+    thin ones); heads are split by reshape.
+  * softmax runs in f32 regardless of the compute dtype (bf16-safe), and the
+    mask fill value is the dtype minimum rather than a hard-coded -1e9.
+  * the attention inner function is pluggable (``attn_fn``) so the same block
+    serves full attention and ring/blockwise attention over a sequence mesh
+    axis (``tdfo_tpu/parallel/ring_attention.py``) without re-wiring.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+__all__ = ["dot_product_attention", "MultiHeadAttention", "FeedForward", "TransformerBlock"]
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, H, T, Dh]
+    k: jax.Array,  # [B, H, S, Dh]
+    v: jax.Array,  # [B, H, S, Dh]
+    mask: jax.Array | None = None,  # broadcastable to [B, H, T, S]; True = attend
+) -> jax.Array:
+    """Scaled dot-product attention (``torchrec/models.py:11-28`` parity),
+    f32 softmax, mask fill = f32 min."""
+    dh = q.shape[-1]
+    logits = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) / jnp.sqrt(dh)
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhts,bhsd->bhtd", probs.astype(v.dtype), v)
+
+
+class MultiHeadAttention(nn.Module):
+    """Multi-head self-attention (``torchrec/models.py:31-71`` parity) with a
+    fused QKV projection and a pluggable attention core."""
+
+    n_heads: int
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = staticmethod(dot_product_attention)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array | None = None, *,
+                 deterministic: bool = True) -> jax.Array:
+        b, t, d = x.shape
+        if d % self.n_heads:
+            raise ValueError(f"dim {d} not divisible by {self.n_heads} heads")
+        dh = d // self.n_heads
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(x)  # [B, T, 3D]
+        qkv = qkv.reshape(b, t, 3, self.n_heads, dh)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,H,T,Dh]
+        out = self.attn_fn(q, k, v, mask)  # [B, H, T, Dh]
+        out = jnp.moveaxis(out, 1, 2).reshape(b, t, d)
+        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        return nn.Dense(d, dtype=self.dtype, name="out")(out)
+
+
+class FeedForward(nn.Module):
+    """Position-wise FFN (``torchrec/models.py:74-88`` parity), GELU."""
+
+    hidden_dim: int
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        d = x.shape[-1]
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc1")(x)
+        h = jax.nn.gelu(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+
+
+class TransformerBlock(nn.Module):
+    """Pre-norm residual block (``torchrec/models.py:91-129`` parity:
+    ``x + dropout(sublayer(LN(x)))`` for attention then FFN)."""
+
+    n_heads: int
+    ff_dim: int
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+    attn_fn: Callable = staticmethod(dot_product_attention)
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array | None = None, *,
+                 deterministic: bool = True) -> jax.Array:
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_attn")(x)
+        h = MultiHeadAttention(
+            self.n_heads, self.dropout, self.dtype, attn_fn=self.attn_fn, name="attn"
+        )(h, mask, deterministic=deterministic)
+        x = x + nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_ff")(x)
+        h = FeedForward(self.ff_dim, self.dropout, self.dtype, name="ff")(
+            h, deterministic=deterministic
+        )
+        return x + nn.Dropout(self.dropout)(h, deterministic=deterministic)

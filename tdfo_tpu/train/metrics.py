@@ -93,8 +93,7 @@ class AUC:
         """Trapezoidal area under (FPR, TPR); ties within a bin count half."""
         total_pos = self.pos_hist.sum()
         total_neg = self.neg_hist.sum()
-        # pos_above[i] = positives in bins > i (strictly); within-bin = tie
-        pos_above = jnp.cumsum(self.pos_hist[::-1])[::-1] - self.pos_hist
+        # neg_above[i] = negatives in bins strictly above i; within-bin = tie
         neg_above = jnp.cumsum(self.neg_hist[::-1])[::-1] - self.neg_hist
         # Each bin-b positive beats neg strictly below, halves neg in-bin:
         # U = sum_b pos[b] * (neg_below[b] + 0.5 * neg[b])
@@ -127,17 +126,19 @@ def recalls_and_ndcgs_for_ks(
     labels = labels.astype(jnp.float32)
     n_pos = labels.sum(axis=1)
     out: dict[str, jax.Array] = {}
-    k_max = max(ks)
+    # k larger than the candidate count degrades to @C (all candidates ranked)
+    k_max = min(max(ks), c)
     _, topk_idx = jax.lax.top_k(scores, k_max)  # [B, k_max]
     hit = jnp.take_along_axis(labels, topk_idx, axis=1)  # [B, k_max]
     positions = jnp.arange(k_max, dtype=jnp.float32)
     gains = 1.0 / jnp.log2(positions + 2.0)
     for k in ks:
-        hits_k = hit[:, :k]
-        recall = hits_k.sum(axis=1) / jnp.maximum(jnp.minimum(float(k), n_pos), 1.0)
-        dcg = (hits_k * gains[:k]).sum(axis=1)
-        ideal_hits = (positions[:k][None, :] < n_pos[:, None]).astype(jnp.float32)
-        idcg = (ideal_hits * gains[:k]).sum(axis=1)
+        kk = min(k, c)  # clamp the cut, keep the requested name
+        hits_k = hit[:, :kk]
+        recall = hits_k.sum(axis=1) / jnp.maximum(jnp.minimum(float(kk), n_pos), 1.0)
+        dcg = (hits_k * gains[:kk]).sum(axis=1)
+        ideal_hits = (positions[:kk][None, :] < n_pos[:, None]).astype(jnp.float32)
+        idcg = (ideal_hits * gains[:kk]).sum(axis=1)
         ndcg = dcg / jnp.maximum(idcg, 1e-9)
         out[f"Recall@{k}"] = (recall * w).sum() / denom
         out[f"NDCG@{k}"] = (ndcg * w).sum() / denom

@@ -69,18 +69,29 @@ def make_sparse_train_step(
     ``forward(dense_params, embeddings, batch) -> scalar loss`` receives the
     gathered vectors ``{feature: [**ids_shape, D]}`` — the model under this
     step consumes embeddings as inputs (HistoryArch-style,
-    ``torchrec/models.py:163-178``) rather than owning the tables.
+    ``torchrec/models.py:163-178``) rather than owning the tables.  A forward
+    that also accepts a ``dropout_rng`` keyword gets a per-step key derived
+    from the rng passed to the step (``step(state, batch, rng)``), enabling
+    stochastic regularisation in this regime.
 
     ``batch`` must contain an id array for every feature the collection
     serves (same key names).
     """
-    features = list(coll.features())
+    import inspect
 
-    def step(state: SparseTrainState, batch) -> tuple[SparseTrainState, jax.Array]:
+    features = list(coll.features())
+    takes_rng = "dropout_rng" in inspect.signature(forward).parameters
+
+    def step(state: SparseTrainState, batch, rng=None) -> tuple[SparseTrainState, jax.Array]:
         ids = {f: batch[f] for f in features}
+        step_rng = None
+        if takes_rng and rng is not None:
+            step_rng = jax.random.fold_in(rng, state.step)
 
         # Gradients w.r.t. the gathered vectors, never the [V, D] table.
         def loss_from_embs(dense_params, embs):
+            if takes_rng:
+                return forward(dense_params, embs, batch, dropout_rng=step_rng)
             return forward(dense_params, embs, batch)
 
         embs = coll.lookup(state.tables, ids, mode=mode)

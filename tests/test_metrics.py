@@ -124,3 +124,12 @@ class TestRankingMetrics:
         f = jax.jit(lambda s, l: recalls_and_ndcgs_for_ks(s, l, ks=(2,)))
         m = f(jnp.array([[0.9, 0.1, 0.5]]), jnp.array([[1.0, 0.0, 0.0]]))
         assert float(m["Recall@2"]) == 1.0
+
+
+def test_ranking_ks_larger_than_candidates_clamp():
+    scores = jnp.array([[0.9, 0.1, 0.5]])
+    labels = jnp.array([[1.0, 0.0, 0.0]])
+    m = recalls_and_ndcgs_for_ks(scores, labels, ks=(10, 50))
+    # clamped to @3: positive is ranked first
+    assert float(m["Recall@10"]) == 1.0
+    assert float(m["Recall@50"]) == 1.0
