@@ -1,0 +1,113 @@
+"""Pallas-kernel micro-benchmarks vs their XLA formulations (real chip).
+
+Supplementary to bench.py (the driver's single-line headline metric): prints
+one JSON line PER kernel comparison.  Inputs VARY per timed iteration — the
+tunnelled TPU runtime caches identical executions, so repeating one input
+measures the cache, not the chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _time_varying(f, inputs_list) -> float:
+    """min ms over calls with distinct inputs; first input used to compile."""
+    jax.block_until_ready(f(*inputs_list[0]))
+    times = []
+    for inputs in inputs_list[1:]:
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*inputs))
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1e3
+
+
+def bench_flash(t: int = 4096, n_iters: int = 6) -> dict:
+    from tdfo_tpu.ops.pallas_kernels import flash_attention
+
+    b, h, dh = 1, 8, 64
+    inputs = []
+    for i in range(n_iters):
+        ks = jax.random.split(jax.random.key(i), 3)
+        inputs.append(tuple(
+            jax.random.normal(kk, (b, h, t, dh), jnp.bfloat16) for kk in ks
+        ))
+    jax.block_until_ready(inputs)
+
+    def xla_attn(q, k, v):
+        s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) / dh**0.5
+        return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, -1).astype(v.dtype), v)
+
+    pl_ms = _time_varying(
+        jax.jit(lambda q, k, v: flash_attention(q, k, v, None, 128, 128, False)),
+        inputs,
+    )
+    xla_ms = _time_varying(jax.jit(xla_attn), inputs)
+    return {
+        "metric": f"flash_attention_T{t}_ms",
+        "value": round(pl_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(xla_ms / pl_ms, 3),  # >1 = pallas faster
+    }
+
+
+def bench_sparse_adam(v: int = 2_000_000, d: int = 128, b: int = 8192,
+                      n_iters: int = 5) -> dict:
+    from tdfo_tpu.ops.pallas_kernels import sparse_adam_rows
+    from tdfo_tpu.ops.sparse import dedupe_grads, sparse_adam
+
+    rng = np.random.default_rng(0)
+    table_h = rng.normal(size=(v, d)).astype(np.float32)
+    count = jnp.asarray(1, jnp.int32)
+
+    def make_inputs(seed):
+        r = np.random.default_rng(seed)
+        ids = jnp.asarray(r.integers(0, v, b).astype(np.int32))
+        grads = jnp.asarray(r.normal(size=(b, d)).astype(np.float32))
+        uids, g, valid = dedupe_grads(ids, grads)
+        # fresh (copied) state buffers so donation never reuses deleted arrays
+        return (
+            jnp.array(table_h), jnp.zeros((v, d)), jnp.zeros((v, d)),
+            uids, g, valid,
+        )
+
+    f_pl = jax.jit(
+        lambda t_, m_, n_, u_, g_, _v: sparse_adam_rows(
+            t_, m_, n_, u_, g_, count, lr=1e-2
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+    f_x = jax.jit(
+        lambda t_, m_, n_, u_, g_, v_: sparse_adam(
+            t_, m_, n_, count - 1, u_, g_, v_, lr=1e-2
+        )[:3],
+        donate_argnums=(0, 1, 2),
+    )
+
+    def run(f, seed):
+        inputs = make_inputs(seed)
+        jax.block_until_ready(inputs)
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*inputs))
+        return (time.perf_counter() - t0) * 1e3
+
+    run(f_pl, 0)  # compile
+    run(f_x, 0)
+    pl_ms = min(run(f_pl, i + 1) for i in range(n_iters))
+    xla_ms = min(run(f_x, i + 1) for i in range(n_iters))
+    return {
+        "metric": f"sparse_adam_V{v}_B{b}_ms",
+        "value": round(pl_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(xla_ms / pl_ms, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_flash()))
+    print(json.dumps(bench_sparse_adam()))

@@ -180,6 +180,15 @@ def make_sharded_bert4rec(
         from tdfo_tpu.parallel.ring_attention import make_ring_attn_fn
 
         attn_fn = make_ring_attn_fn(mesh)
+    elif attn == "flash":
+        # single-device long-context path: Pallas blockwise online-softmax
+        # kernel, O(T) memory (tdfo_tpu/ops/pallas_kernels.py)
+        from tdfo_tpu.ops.pallas_kernels import flash_attention
+
+        def attn_fn(q, k, v, mask=None):
+            key_valid = None if mask is None else mask[:, 0, 0, :]
+            interp = jax.default_backend() != "tpu"
+            return flash_attention(q, k, v, key_valid, 128, 128, interp)
     elif attn == "full":
         attn_fn = dot_product_attention
     else:

@@ -133,6 +133,10 @@ class SparseOptimizer:
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
+    # adam only: route through the Pallas fused gather kernel
+    # (tdfo_tpu/ops/pallas_kernels.sparse_adam_rows); falls back to interpret
+    # mode off-TPU so numerics are identical everywhere.
+    use_pallas: bool = False
 
     def init(self, table: jax.Array) -> Any:
         if self.kind == "sgd":
@@ -160,6 +164,17 @@ class SparseOptimizer:
             return table, (accum,)
         if self.kind == "adam":
             mu, nu, count = slots
+            if self.use_pallas:
+                from tdfo_tpu.ops.pallas_kernels import sparse_adam_rows
+
+                interp = jax.default_backend() != "tpu"
+                new_count = count + 1
+                table, mu, nu = sparse_adam_rows(
+                    table, mu, nu, uids, g, new_count, lr=self.lr, b1=self.b1,
+                    b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+                    interpret=interp,
+                )
+                return table, (mu, nu, new_count)
             table, mu, nu, count = sparse_adam(
                 table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
