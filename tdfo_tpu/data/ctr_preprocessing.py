@@ -138,6 +138,17 @@ def split_interactions(df: pd.DataFrame, is_train: bool) -> pd.DataFrame:
     return df.loc[keep, ["user_id", "book_id"]]
 
 
+def _join_split_part(part: pd.DataFrame, split_key: pd.MultiIndex,
+                     book_features: pd.DataFrame) -> pd.DataFrame:
+    """Restrict interaction rows to the split's (user, item) pairs and join
+    book features into the final training schema (shared by both formats)."""
+    mask = pd.MultiIndex.from_frame(part[["user_id", "book_id"]]).isin(split_key)
+    part = part[mask]
+    return part.merge(book_features, on="book_id", how="left").rename(
+        columns={"book_id": "item_id"}
+    )[FINAL_COLUMNS]
+
+
 def write_parquet_shards(
     data_dir: Path,
     split_pairs: pd.DataFrame,
@@ -155,20 +166,18 @@ def write_parquet_shards(
     key = pd.MultiIndex.from_frame(split_pairs)
     paths = []
     for i, start, end in shard_ranges(len(interactions), file_num):
-        part = interactions.iloc[start:end]
-        mask = pd.MultiIndex.from_frame(part[["user_id", "book_id"]]).isin(key)
-        part = part[mask]
-        part = part.merge(book_features, on="book_id", how="left").rename(
-            columns={"book_id": "item_id"}
-        )[FINAL_COLUMNS]
+        part = _join_split_part(interactions.iloc[start:end], key, book_features)
         paths.append(write_df_part(part, write_dir, prefix, i,
                                    shuffle=prefix == "train", seed=seed))
     return paths
 
 
 def run_ctr_preprocessing(data_dir: str | Path, *, file_num: int = FILE_NUM,
-                          seed: int = 42) -> dict[str, int]:
-    """Full ETL: raw goodreads files -> parquet shards + size_map.json."""
+                          seed: int = 42,
+                          write_format: str = "parquet") -> dict[str, int]:
+    """Full ETL: raw goodreads files -> parquet or tfrecord shards +
+    size_map.json (``write_format`` dispatch parity,
+    ``tensorflow2/data.py:70-105``)."""
     data_dir = Path(data_dir)
     book_features, size_map = get_book_features(data_dir)
     with open(data_dir / "size_map.json", "w") as f:
@@ -183,8 +192,23 @@ def run_ctr_preprocessing(data_dir: str | Path, *, file_num: int = FILE_NUM,
         raise ValueError("interaction book_id outside [0, n_items) of book_id_map")
     for prefix, is_train in (("train", True), ("eval", False)):
         pairs = split_interactions(interactions, is_train)
-        write_parquet_shards(
-            data_dir, pairs, interactions, book_features, prefix,
-            file_num=file_num, seed=seed,
-        )
+        if write_format == "parquet":
+            write_parquet_shards(
+                data_dir, pairs, interactions, book_features, prefix,
+                file_num=file_num, seed=seed,
+            )
+        elif write_format == "tfrecord":
+            from tdfo_tpu.data.tfrecord import write_tfrecord_shards
+
+            part = _join_split_part(
+                interactions, pd.MultiIndex.from_frame(pairs), book_features
+            )
+            if prefix == "train":
+                part = part.sample(frac=1.0, random_state=seed)
+            write_tfrecord_shards(
+                {c: part[c].to_numpy() for c in part.columns},
+                data_dir / "tfrecord", prefix, file_num=file_num,
+            )
+        else:
+            raise ValueError(f"unknown write_format {write_format!r}")
     return size_map

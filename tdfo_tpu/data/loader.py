@@ -37,6 +37,7 @@ import pyarrow.parquet as pq
 
 __all__ = [
     "ParquetStream",
+    "TFRecordStream",
     "load_parquet_table",
     "permutation_batches",
     "prefetch_to_mesh",
@@ -123,6 +124,16 @@ class ParquetStream:
             self.process_count > 1 and len(self.files) % self.process_count == 0
         )
 
+    # ---- file-format hooks (overridden by TFRecordStream) ----
+
+    def _file_row_count(self, path: str) -> int:
+        return pq.ParquetFile(path).metadata.num_rows
+
+    def _file_batches(self, path: str):
+        pf = pq.ParquetFile(path)
+        for rb in pf.iter_batches(batch_size=65536, columns=self.columns):
+            yield _to_numpy_columns(rb)
+
     def _batches_per_host(self) -> int | None:
         """Cross-host batch budget from parquet metadata (no communication).
 
@@ -135,7 +146,7 @@ class ParquetStream:
         if self._shard_by_file:
             rows = [
                 sum(
-                    pq.ParquetFile(f).metadata.num_rows
+                    self._file_row_count(f)
                     for f in self.files[r :: self.process_count]
                 )
                 for r in range(self.process_count)
@@ -144,7 +155,7 @@ class ParquetStream:
         else:
             # strided: rank r owns global rows g with g % P == r_assigned;
             # the smallest share is floor(N / P).
-            n = sum(pq.ParquetFile(f).metadata.num_rows for f in self.files)
+            n = sum(self._file_row_count(f) for f in self.files)
             min_rows = n // self.process_count
         return min_rows // self.batch_size
 
@@ -163,11 +174,11 @@ class ParquetStream:
         for r in range(max(self.process_count, 1)):
             if self._shard_by_file:
                 rows = sum(
-                    pq.ParquetFile(f).metadata.num_rows
+                    self._file_row_count(f)
                     for f in self.files[r :: self.process_count]
                 )
             else:
-                n = sum(pq.ParquetFile(f).metadata.num_rows for f in self.files)
+                n = sum(self._file_row_count(f) for f in self.files)
                 p = max(self.process_count, 1)
                 rows = (n - r + p - 1) // p
             counts.append(-(-rows // self.batch_size))
@@ -193,9 +204,7 @@ class ParquetStream:
         def raw_batches():
             stride_pos = 0
             for f in files:
-                pf = pq.ParquetFile(f)
-                for rb in pf.iter_batches(batch_size=65536, columns=self.columns):
-                    d = _to_numpy_columns(rb)
+                for d in self._file_batches(f):
                     if not self._shard_by_file and self.process_count > 1:
                         # strided slice so every host sees a disjoint subset
                         n = len(next(iter(d.values())))
@@ -243,6 +252,55 @@ class ParquetStream:
             yield from emit(_take(rows, rng.permutation(pooled)))
         if pend_n and not self.drop_last:
             yield _concat_rows(pending)
+
+
+class TFRecordStream(ParquetStream):
+    """The same streaming pipeline over TFRecord shards
+    (``tensorflow2/data.py:171-210`` capability — schema comes from the
+    Example protos themselves instead of ``FixedLenFeature`` declarations).
+
+    Row counts come from the ``{prefix}_data_size.json`` sidecar written at
+    preprocessing time (``tensorflow2/data.py:83-84`` parity); scanning a
+    gzip TFRecord just to count it would defeat streaming.
+    """
+
+    def __init__(self, files, batch_size, *, compression: str | None = "GZIP",
+                 **kw):
+        super().__init__(files, batch_size, **kw)
+        self.compression = compression
+        self._row_counts: dict[str, int] = {}
+
+    def _file_row_count(self, path: str) -> int:
+        from tdfo_tpu.data.tfrecord import read_shard_sizes, read_tfrecord_records
+
+        if path not in self._row_counts:
+            p = Path(path)
+            prefix = p.name.split("_part_")[0]
+            sizes = read_shard_sizes(p.parent, prefix)
+            if sizes is not None and p.name in sizes:
+                for name, n in sizes.items():
+                    self._row_counts[str(p.parent / name)] = n
+            else:  # no per-shard sidecar: count by scanning once
+                self._row_counts[path] = sum(
+                    1 for _ in read_tfrecord_records(path, self.compression)
+                )
+        return self._row_counts[path]
+
+    def _file_batches(self, path: str):
+        from tdfo_tpu.data.tfrecord import (
+            decode_example,
+            read_tfrecord_records,
+            stack_example_rows,
+        )
+
+        rows: list[dict[str, np.ndarray]] = []
+        for payload in read_tfrecord_records(path, self.compression):
+            rows.append(decode_example(payload))
+            if len(rows) >= 8192:
+                yield stack_example_rows(rows, self.columns)
+                rows = []
+        if rows:
+            yield stack_example_rows(rows, self.columns)
 
 
 def count_rows(files: Sequence[str]) -> int:

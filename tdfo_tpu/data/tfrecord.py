@@ -1,0 +1,348 @@
+"""TFRecord support without TensorFlow — native C++ codec + pure-Python fallback.
+
+The reference's ``write_format = "tfrecord"`` path leans on TF's C++ runtime
+(``tensorflow2/data.py:70-131``: ``tf.io.TFRecordWriter`` with GZIP,
+``tf.train.Example`` protos, ``FixedLenFeature`` parsing, and a
+``{prefix}_data_size.json`` row-count sidecar).  This module re-implements
+that contract standalone:
+
+  * ``tf.train.Example`` protobuf wire format (Features map of
+    bytes_list/float_list/int64_list) encoded/decoded directly — no protobuf
+    runtime needed for these three fixed shapes.
+  * TFRecord framing (u64 length + masked crc32c + payload + crc) via the
+    C++ library (``tdfo_tpu/native``) when available, pure Python otherwise;
+    GZIP optional exactly like the reference.
+  * row-count sidecar parity (``tensorflow2/data.py:83-84`` →
+    ``get_data_size``, ``tensorflow2/utils.py:41-48``).
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import struct
+from pathlib import Path
+from typing import Iterator, Mapping, Sequence
+
+import numpy as np
+
+from tdfo_tpu.native import load_native
+
+__all__ = [
+    "encode_example",
+    "decode_example",
+    "write_tfrecord_file",
+    "read_tfrecord_records",
+    "write_tfrecord_shards",
+    "read_tfrecord_columns",
+    "write_size_sidecar",
+    "read_size_sidecar",
+]
+
+
+# ------------------------------------------------------------ protobuf wire
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_varint(buf: memoryview, pos: int) -> tuple[int, int]:
+    shift = 0
+    val = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, pos
+        shift += 7
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    """Length-delimited field (wire type 2)."""
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def encode_example(row: Mapping[str, object]) -> bytes:
+    """One ``tf.train.Example`` from a dict of scalars/sequences.
+
+    int -> int64_list, float -> float_list, bytes/str -> bytes_list
+    (the schema at ``tensorflow2/data.py:108-131``)."""
+    entries = b""
+    for key, value in row.items():
+        kind = None  # "bytes" | "float" | "int"; None = infer from values
+        if isinstance(value, np.ndarray):
+            if np.issubdtype(value.dtype, np.floating):
+                kind = "float"
+            elif np.issubdtype(value.dtype, np.integer):
+                kind = "int"
+        if isinstance(value, (bytes, str)):
+            values = [value.encode() if isinstance(value, str) else value]
+        elif isinstance(value, (int, np.integer, float, np.floating)):
+            values = [value]
+        else:
+            values = list(value)
+        if kind == "bytes" or (kind is None and values and isinstance(values[0], (bytes, str))):
+            payload = b"".join(
+                _ld(1, v.encode() if isinstance(v, str) else v) for v in values
+            )
+            feature = _ld(1, payload)  # Feature.bytes_list
+        elif kind == "float" or (kind is None and values and isinstance(values[0], (float, np.floating))):
+            packed = struct.pack(f"<{len(values)}f", *values)
+            feature = _ld(2, _varint(1 << 3 | 2) + _varint(len(packed)) + packed)
+        else:
+            packed = b"".join(_varint(int(v) & (2**64 - 1)) for v in values)
+            feature = _ld(3, _varint(1 << 3 | 2) + _varint(len(packed)) + packed)
+        entry = _ld(1, key.encode()) + _ld(2, feature)  # map entry
+        entries += _ld(1, entry)  # Features.feature
+    return _ld(1, entries)  # Example.features
+
+
+def _decode_list(buf: memoryview) -> list:
+    """BytesList/FloatList/Int64List inner payload -> python list."""
+    pos = 0
+    out: list = []
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        wt = tag & 7
+        if wt == 2:  # bytes value OR packed numeric run
+            ln, pos = _read_varint(buf, pos)
+            out.append(bytes(buf[pos : pos + ln]))
+            pos += ln
+        elif wt == 0:  # unpacked varint
+            v, pos = _read_varint(buf, pos)
+            out.append(v)
+        elif wt == 5:  # unpacked float
+            out.append(struct.unpack("<f", buf[pos : pos + 4])[0])
+            pos += 4
+        else:
+            raise ValueError(f"unexpected wire type {wt} in list")
+    return out
+
+
+def decode_example(payload: bytes) -> dict[str, np.ndarray]:
+    """Example bytes -> dict of numpy arrays (int64 / float32 / object)."""
+    buf = memoryview(payload)
+    pos = 0
+    out: dict[str, np.ndarray] = {}
+    tag, pos = _read_varint(buf, pos)
+    assert tag >> 3 == 1, "not an Example"
+    flen, pos = _read_varint(buf, pos)
+    features = buf[pos : pos + flen]
+    fpos = 0
+    while fpos < len(features):
+        tag, fpos = _read_varint(features, fpos)
+        elen, fpos = _read_varint(features, fpos)
+        entry = features[fpos : fpos + elen]
+        fpos += elen
+        epos = 0
+        key = None
+        feature = None
+        while epos < len(entry):
+            tag, epos = _read_varint(entry, epos)
+            ln, epos = _read_varint(entry, epos)
+            if tag >> 3 == 1:
+                key = bytes(entry[epos : epos + ln]).decode()
+            else:
+                feature = entry[epos : epos + ln]
+            epos += ln
+        if key is None or feature is None:
+            continue
+        ftag, fp = _read_varint(feature, 0)
+        kind = ftag >> 3  # 1 bytes, 2 float, 3 int64
+        llen, fp = _read_varint(feature, fp)
+        inner = feature[fp : fp + llen]
+        if kind == 1:
+            out[key] = np.array(_decode_list(inner), dtype=object)
+        else:
+            # inner is `repeated value` — either one packed blob or unpacked
+            ipos = 0
+            vals: list = []
+            while ipos < len(inner):
+                vtag, ipos = _read_varint(inner, ipos)
+                if vtag & 7 == 2:  # packed
+                    ln, ipos = _read_varint(inner, ipos)
+                    blob = inner[ipos : ipos + ln]
+                    ipos += ln
+                    if kind == 2:
+                        vals.extend(struct.unpack(f"<{len(blob) // 4}f", blob))
+                    else:
+                        bpos = 0
+                        while bpos < len(blob):
+                            v, bpos = _read_varint(blob, bpos)
+                            vals.append(v - 2**64 if v >= 2**63 else v)
+                elif vtag & 7 == 5:
+                    vals.append(struct.unpack("<f", inner[ipos : ipos + 4])[0])
+                    ipos += 4
+                else:
+                    v, ipos = _read_varint(inner, ipos)
+                    vals.append(v - 2**64 if v >= 2**63 else v)
+            out[key] = np.asarray(
+                vals, dtype=np.float32 if kind == 2 else np.int64
+            )
+    return out
+
+
+# -------------------------------------------------------------- frame codec
+
+
+_CRC_TABLE: list[int] | None = None
+
+
+def _crc32c_py(data: bytes) -> int:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78
+        tbl = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (poly ^ (c >> 1)) if c & 1 else c >> 1
+            tbl.append(c)
+        _CRC_TABLE = tbl
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    lib = load_native()
+    if lib is not None and data:
+        import ctypes
+
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        return lib.tdfo_masked_crc32c(buf, len(data))
+    crc = _crc32c_py(data)
+    return (((crc >> 15) | ((crc << 17) & 0xFFFFFFFF)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def write_tfrecord_file(path: str | Path, records: Sequence[bytes],
+                        compression: str | None = "GZIP") -> None:
+    """Framed records to a file; GZIP matches the reference's writer options
+    (``tensorflow2/data.py:114-116``)."""
+    opener = gzip.open if compression == "GZIP" else open
+    with opener(path, "wb") as f:
+        for payload in records:
+            hdr = struct.pack("<Q", len(payload))
+            f.write(hdr)
+            f.write(struct.pack("<I", _masked_crc(hdr)))
+            f.write(payload)
+            f.write(struct.pack("<I", _masked_crc(payload)))
+
+
+def read_tfrecord_records(path: str | Path,
+                          compression: str | None = "GZIP") -> Iterator[bytes]:
+    """Yield verified record payloads."""
+    opener = gzip.open if compression == "GZIP" else open
+    with opener(path, "rb") as f:
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            if len(hdr) != 12:
+                raise IOError("truncated tfrecord header")
+            (n,) = struct.unpack("<Q", hdr[:8])
+            (crc,) = struct.unpack("<I", hdr[8:])
+            if _masked_crc(hdr[:8]) != crc:
+                raise IOError("tfrecord length crc mismatch")
+            payload = f.read(n)
+            (dcrc,) = struct.unpack("<I", f.read(4))
+            if _masked_crc(payload) != dcrc:
+                raise IOError("tfrecord data crc mismatch")
+            yield payload
+
+
+# ---------------------------------------------------------- columnar layer
+
+
+def write_tfrecord_shards(
+    columns: Mapping[str, np.ndarray],
+    write_dir: str | Path,
+    prefix: str,
+    *,
+    file_num: int = 8,
+    compression: str | None = "GZIP",
+) -> list[Path]:
+    """Dict-of-arrays -> Example-per-row tfrecord shards + row-count sidecar
+    (``tensorflow2/data.py:70-105`` parity)."""
+    write_dir = Path(write_dir)
+    write_dir.mkdir(parents=True, exist_ok=True)
+    n = len(next(iter(columns.values())))
+    from tdfo_tpu.data.shards import shard_ranges
+
+    paths = []
+    shard_sizes: dict[str, int] = {}
+    for i, start, end in shard_ranges(n, file_num):
+        records = [
+            encode_example({k: v[r] for k, v in columns.items()})
+            for r in range(start, end)
+        ]
+        p = write_dir / f"{prefix}_part_{i}.tfrecord"
+        write_tfrecord_file(p, records, compression)
+        shard_sizes[p.name] = end - start
+        paths.append(p)
+    write_size_sidecar(write_dir, prefix, n, shard_sizes)
+    return paths
+
+
+def read_tfrecord_columns(
+    files: Sequence[str | Path], compression: str | None = "GZIP"
+) -> dict[str, np.ndarray]:
+    """All rows of the shards as stacked columns (map-style read)."""
+    rows = []
+    for f in files:
+        for payload in read_tfrecord_records(f, compression):
+            rows.append(decode_example(payload))
+    return stack_example_rows(rows) if rows else {}
+
+
+def write_size_sidecar(write_dir: str | Path, prefix: str, n_rows: int,
+                       shard_sizes: Mapping[str, int] | None = None) -> None:
+    payload: dict = {"data_size": int(n_rows)}
+    if shard_sizes:
+        payload["shard_sizes"] = {k: int(v) for k, v in shard_sizes.items()}
+    with open(Path(write_dir) / f"{prefix}_data_size.json", "w") as f:
+        json.dump(payload, f)
+
+
+def read_size_sidecar(write_dir: str | Path, prefix: str) -> int | None:
+    p = Path(write_dir) / f"{prefix}_data_size.json"
+    if not p.exists():
+        return None
+    with open(p) as f:
+        return int(json.load(f)["data_size"])
+
+
+def read_shard_sizes(write_dir: str | Path, prefix: str) -> dict[str, int] | None:
+    """Per-shard row counts recorded by :func:`write_tfrecord_shards`."""
+    p = Path(write_dir) / f"{prefix}_data_size.json"
+    if not p.exists():
+        return None
+    with open(p) as f:
+        sizes = json.load(f).get("shard_sizes")
+    return {k: int(v) for k, v in sizes.items()} if sizes else None
+
+
+def stack_example_rows(
+    rows: Sequence[Mapping[str, np.ndarray]],
+    columns: Sequence[str] | None = None,
+) -> dict[str, np.ndarray]:
+    """Decoded Example rows -> dict of columns: length-1 features concatenate
+    to scalars, fixed-width features stack to [B, T]."""
+    out: dict[str, np.ndarray] = {}
+    for k in rows[0]:
+        if columns is not None and k not in columns:
+            continue
+        vals = [r[k] for r in rows]
+        out[k] = np.concatenate(vals) if all(len(v) == 1 for v in vals) else np.stack(vals)
+    return out

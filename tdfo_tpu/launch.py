@@ -59,7 +59,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "preprocess-ctr":
         from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
 
-        size_map = run_ctr_preprocessing(cfg.data_dir, seed=cfg.seed)
+        size_map = run_ctr_preprocessing(
+            cfg.data_dir, seed=cfg.seed, write_format=cfg.write_format
+        )
         print(f"size_map: {size_map}")
         return 0
     if args.command == "preprocess-seq":

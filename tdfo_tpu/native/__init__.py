@@ -1,0 +1,76 @@
+"""Native (C++) data-plane loader — builds on demand, ctypes ABI.
+
+The library is compiled lazily with g++ the first time it is needed and
+cached under ``native/build/``; a missing toolchain degrades gracefully
+(``load_native()`` returns None and callers use their pure-Python paths).
+This mirrors how the reference leans on prebuilt native wheels (fbgemm, TF's
+C++ runtime — SURVEY.md §2.2) without requiring any here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import subprocess
+import threading
+from pathlib import Path
+
+__all__ = ["load_native", "native_available"]
+
+_SRC = Path(__file__).parent / "tdfo_native.cc"
+_BUILD_DIR = Path(__file__).parent / "build"
+_LIB_PATH = _BUILD_DIR / "libtdfo_native.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _build() -> bool:
+    _BUILD_DIR.mkdir(exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(_LIB_PATH),
+        str(_SRC),
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.tdfo_crc32c.argtypes = [u8p, ctypes.c_uint64]
+    lib.tdfo_crc32c.restype = ctypes.c_uint32
+    lib.tdfo_masked_crc32c.argtypes = [u8p, ctypes.c_uint64]
+    lib.tdfo_masked_crc32c.restype = ctypes.c_uint32
+    lib.tdfo_file_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.tdfo_file_open.restype = ctypes.c_void_p
+    lib.tdfo_file_close.argtypes = [ctypes.c_void_p]
+    lib.tdfo_tfrecord_write.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    lib.tdfo_tfrecord_next_len.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.tdfo_tfrecord_read_payload.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    lib.tdfo_shuffle_rows.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+    lib.tdfo_shuffle_rows.restype = None
+    return lib
+
+
+def load_native() -> ctypes.CDLL | None:
+    """The shared library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < _SRC.stat().st_mtime:
+            if not _build():
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(str(_LIB_PATH)))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None

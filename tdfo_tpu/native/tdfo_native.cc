@@ -1,0 +1,151 @@
+// Native data-plane core for tdfo_tpu — built as a plain C ABI shared
+// library (ctypes-loaded; this image has no pybind11).
+//
+// The reference delegates its native data plane to TensorFlow's C++ runtime
+// (TFRecord framing + gzip + tf.data, tensorflow2/data.py:108-210) and to
+// torch's pinned-memory DataLoader workers.  This library provides the
+// equivalents the Python layer needs without those runtimes:
+//
+//   * crc32c (Castagnoli, slicing-by-8) — the TFRecord integrity checksum.
+//   * TFRecord frame reader/writer — the on-disk format:
+//       u64le length | u32le masked_crc(length) | payload | u32le masked_crc(payload)
+//   * in-place Fisher-Yates shuffle of fixed-stride rows (splitmix64 PRNG) —
+//     the shuffle-buffer permutation without numpy's gather copy.
+//
+// Everything is exception-free, allocates nothing it does not free, and
+// reports errors by return code (0 = ok).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32c
+
+static uint32_t kCrcTable[8][256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  if (crc_init_done) return;
+  const uint32_t poly = 0x82f63b78u;  // reflected Castagnoli
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (poly ^ (c >> 1)) : (c >> 1);
+    kCrcTable[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = kCrcTable[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = kCrcTable[0][c & 0xff] ^ (c >> 8);
+      kCrcTable[t][i] = c;
+    }
+  }
+  crc_init_done = true;
+}
+
+uint32_t tdfo_crc32c(const uint8_t* data, uint64_t n) {
+  crc_init();
+  uint32_t crc = 0xffffffffu;
+  // slicing-by-8 over aligned middle
+  while (n >= 8) {
+    crc ^= (uint32_t)data[0] | ((uint32_t)data[1] << 8) |
+           ((uint32_t)data[2] << 16) | ((uint32_t)data[3] << 24);
+    uint32_t hi = (uint32_t)data[4] | ((uint32_t)data[5] << 8) |
+                  ((uint32_t)data[6] << 16) | ((uint32_t)data[7] << 24);
+    crc = kCrcTable[7][crc & 0xff] ^ kCrcTable[6][(crc >> 8) & 0xff] ^
+          kCrcTable[5][(crc >> 16) & 0xff] ^ kCrcTable[4][(crc >> 24) & 0xff] ^
+          kCrcTable[3][hi & 0xff] ^ kCrcTable[2][(hi >> 8) & 0xff] ^
+          kCrcTable[1][(hi >> 16) & 0xff] ^ kCrcTable[0][(hi >> 24) & 0xff];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = kCrcTable[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  return crc ^ 0xffffffffu;
+}
+
+// TFRecord "masked" crc: rotate right 15 + magic
+uint32_t tdfo_masked_crc32c(const uint8_t* data, uint64_t n) {
+  uint32_t crc = tdfo_crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+// ------------------------------------------------------------- tfrecord IO
+
+// Append one framed record to an open FILE* (opaque handle from fopen).
+// Returns 0 on success.
+void* tdfo_file_open(const char* path, const char* mode) {
+  return (void*)fopen(path, mode);
+}
+
+int tdfo_file_close(void* f) { return fclose((FILE*)f); }
+
+int tdfo_tfrecord_write(void* fv, const uint8_t* payload, uint64_t n) {
+  FILE* f = (FILE*)fv;
+  uint8_t hdr[12];
+  memcpy(hdr, &n, 8);
+  uint32_t len_crc = tdfo_masked_crc32c(hdr, 8);
+  memcpy(hdr + 8, &len_crc, 4);
+  if (fwrite(hdr, 1, 12, f) != 12) return 1;
+  if (n && fwrite(payload, 1, n, f) != n) return 2;
+  uint32_t data_crc = tdfo_masked_crc32c(payload, n);
+  if (fwrite(&data_crc, 1, 4, f) != 4) return 3;
+  return 0;
+}
+
+// Read the next record's length (verifying the length crc).  Returns 0 and
+// sets *len on success, 1 on clean EOF, negative on corruption.
+int tdfo_tfrecord_next_len(void* fv, uint64_t* len) {
+  FILE* f = (FILE*)fv;
+  uint8_t hdr[12];
+  size_t got = fread(hdr, 1, 12, f);
+  if (got == 0) return 1;  // EOF
+  if (got != 12) return -1;
+  uint64_t n;
+  memcpy(&n, hdr, 8);
+  uint32_t crc_stored;
+  memcpy(&crc_stored, hdr + 8, 4);
+  if (tdfo_masked_crc32c(hdr, 8) != crc_stored) return -2;
+  *len = n;
+  return 0;
+}
+
+// Read payload of a record whose length was just returned; verifies data crc.
+int tdfo_tfrecord_read_payload(void* fv, uint8_t* out, uint64_t n) {
+  FILE* f = (FILE*)fv;
+  if (fread(out, 1, n, f) != n) return -1;
+  uint32_t crc_stored;
+  if (fread(&crc_stored, 1, 4, f) != 4) return -2;
+  if (tdfo_masked_crc32c(out, n) != crc_stored) return -3;
+  return 0;
+}
+
+// ------------------------------------------------------- row-block shuffle
+
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+// In-place Fisher-Yates over n_rows rows of `stride` bytes each.
+void tdfo_shuffle_rows(uint8_t* data, uint64_t n_rows, uint64_t stride,
+                       uint64_t seed) {
+  if (n_rows < 2) return;
+  uint64_t s = seed ? seed : 1;
+  // swap buffer on stack for small strides, heap otherwise
+  uint8_t small[512];
+  uint8_t* tmp = stride <= sizeof(small) ? small : new uint8_t[stride];
+  for (uint64_t i = n_rows - 1; i > 0; i--) {
+    uint64_t j = splitmix64(&s) % (i + 1);
+    if (j != i) {
+      memcpy(tmp, data + i * stride, stride);
+      memcpy(data + i * stride, data + j * stride, stride);
+      memcpy(data + j * stride, tmp, stride);
+    }
+  }
+  if (tmp != small) delete[] tmp;
+}
+
+}  // extern "C"

@@ -95,12 +95,16 @@ def _flash_fwd_impl(q, k, v, key_valid, block_q, block_k, interpret):
     b, h, t, dh = q.shape
     if key_valid is None:
         key_valid = jnp.ones((b, t), bool)
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
+    # blocks must stay multiples of 8 (Mosaic sublane tile) even when clipped
+    # to a short T
+    block_q = max(8, min(block_q, t) // 8 * 8)
+    block_k = max(8, min(block_k, t) // 8 * 8)
     if t % block_q or t % block_k:
-        # pad T up to a block multiple: padded keys are masked out, padded
-        # query rows are discarded after the call
-        block = max(block_q, block_k)
+        # pad T up to a multiple of BOTH blocks (lcm, so the recursive call
+        # terminates): padded keys are masked out, padded query rows sliced
+        import math
+
+        block = math.lcm(block_q, block_k)
         t_pad = -(-t // block) * block
         pad = t_pad - t
         padded = _flash_fwd_impl(

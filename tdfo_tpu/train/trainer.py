@@ -148,8 +148,17 @@ class Trainer:
         self.state = shard_state(state, self.mesh, rule)
         self.train_step = make_train_step(mesh=self.mesh)
         self.eval_step = make_eval_step(mesh=self.mesh)
-        self._train_pattern = str(Path("parquet") / cfg.train_data)
-        self._eval_pattern = str(Path("parquet") / cfg.eval_data)
+        if cfg.write_format == "tfrecord":
+            from tdfo_tpu.data.loader import TFRecordStream
+
+            self._stream_cls = TFRecordStream
+            to_tfr = lambda pat: pat.replace(".parquet", ".tfrecord")
+            self._train_pattern = str(Path("tfrecord") / to_tfr(cfg.train_data))
+            self._eval_pattern = str(Path("tfrecord") / to_tfr(cfg.eval_data))
+        else:
+            self._stream_cls = ParquetStream
+            self._train_pattern = str(Path("parquet") / cfg.train_data)
+            self._eval_pattern = str(Path("parquet") / cfg.eval_data)
 
     def _build_bert4rec(self) -> None:
         from tdfo_tpu.models.bert4rec import Bert4RecConfig, make_sharded_bert4rec
@@ -185,6 +194,7 @@ class Trainer:
             self.coll, bert4rec_sparse_forward(self.backbone), donate=False
         )
         self._dropout_rng = jax.random.key(cfg.seed + 1)
+        self._stream_cls = ParquetStream  # seq ETL writes parquet only
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
         self._eval_pattern = str(Path("parquet_bert4rec") / cfg.eval_data)
 
@@ -197,7 +207,7 @@ class Trainer:
         # data axis spans every host's devices, and prefetch_to_mesh
         # assembles the global array from per-process chunks.
         local_data = max(1, self.mesh.shape["data"] // jax.process_count())
-        return ParquetStream(
+        return self._stream_cls(
             files,
             batch_size=(cfg.per_device_train_batch_size if train
                         else cfg.per_device_eval_batch_size) * local_data,

@@ -1,0 +1,264 @@
+"""TFRecord codec: crc vectors, proto roundtrip, cross-validation against TF.
+
+TensorFlow happens to be present in this image, so the wire format is checked
+against the real reader/writer — the framework itself never imports TF.
+"""
+
+import numpy as np
+import pytest
+
+from tdfo_tpu.data.tfrecord import (
+    _crc32c_py,
+    decode_example,
+    encode_example,
+    read_size_sidecar,
+    read_tfrecord_columns,
+    read_tfrecord_records,
+    write_tfrecord_file,
+    write_tfrecord_shards,
+)
+from tdfo_tpu.native import load_native, native_available
+
+
+class TestCrc32c:
+    # RFC 3720 test vectors
+    VECTORS = [
+        (b"", 0x00000000),
+        (b"a", 0xC1D04330),
+        (b"123456789", 0xE3069283),
+        (bytes(32), 0x8A9136AA),
+        (bytes([0xFF] * 32), 0x62A8AB43),
+    ]
+
+    def test_python_crc_vectors(self):
+        for data, want in self.VECTORS:
+            assert _crc32c_py(data) == want, data
+
+    def test_native_crc_matches_python(self):
+        lib = load_native()
+        if lib is None:
+            pytest.skip("native toolchain unavailable")
+        import ctypes
+
+        rng = np.random.default_rng(0)
+        for n in (1, 7, 8, 9, 63, 64, 1000):
+            data = rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+            buf = (ctypes.c_uint8 * n).from_buffer_copy(data)
+            assert lib.tdfo_crc32c(buf, n) == _crc32c_py(data)
+
+
+class TestExampleProto:
+    def test_roundtrip(self):
+        row = {
+            "user_id": 42,
+            "score": 0.5,
+            "seq": np.asarray([1, 2, 3], np.int64),
+            "floats": np.asarray([0.25, -1.5], np.float32),
+            "name": b"abc",
+        }
+        out = decode_example(encode_example(row))
+        assert out["user_id"].tolist() == [42]
+        assert out["score"].astype(float).tolist() == [0.5]
+        assert out["seq"].tolist() == [1, 2, 3]
+        np.testing.assert_allclose(out["floats"], [0.25, -1.5])
+        assert out["name"].tolist() == [b"abc"]
+
+    def test_negative_ints(self):
+        out = decode_example(encode_example({"x": np.asarray([-5, 3], np.int64)}))
+        assert out["x"].tolist() == [-5, 3]
+
+    def test_tf_can_parse_ours(self):
+        tf = pytest.importorskip("tensorflow")
+        payload = encode_example({"a": 7, "b": [1.0, 2.0], "c": b"hi"})
+        ex = tf.train.Example.FromString(payload)
+        assert ex.features.feature["a"].int64_list.value[:] == [7]
+        np.testing.assert_allclose(ex.features.feature["b"].float_list.value[:], [1.0, 2.0])
+        assert ex.features.feature["c"].bytes_list.value[:] == [b"hi"]
+
+    def test_we_can_parse_tf(self):
+        tf = pytest.importorskip("tensorflow")
+        ex = tf.train.Example(
+            features=tf.train.Features(
+                feature={
+                    "i": tf.train.Feature(int64_list=tf.train.Int64List(value=[3, -4])),
+                    "f": tf.train.Feature(float_list=tf.train.FloatList(value=[0.5])),
+                }
+            )
+        )
+        out = decode_example(ex.SerializeToString())
+        assert out["i"].tolist() == [3, -4]
+        np.testing.assert_allclose(out["f"], [0.5])
+
+
+class TestTFRecordFraming:
+    def test_roundtrip_plain_and_gzip(self, tmp_path):
+        recs = [b"hello", b"", b"world" * 100]
+        for comp in (None, "GZIP"):
+            p = tmp_path / f"t_{comp}.tfrecord"
+            write_tfrecord_file(p, recs, comp)
+            assert list(read_tfrecord_records(p, comp)) == recs
+
+    def test_tf_reads_our_files(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        p = tmp_path / "ours.tfrecord"
+        payloads = [encode_example({"x": i}) for i in range(5)]
+        write_tfrecord_file(p, payloads, "GZIP")
+        ds = tf.data.TFRecordDataset(str(p), compression_type="GZIP")
+        got = [r.numpy() for r in ds]
+        assert got == payloads
+
+    def test_we_read_tf_files(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        p = str(tmp_path / "tf.tfrecord")
+        opts = tf.io.TFRecordOptions(compression_type="GZIP")
+        with tf.io.TFRecordWriter(p, opts) as w:
+            for i in range(3):
+                w.write(encode_example({"x": i}))
+        got = [decode_example(r)["x"].tolist() for r in read_tfrecord_records(p)]
+        assert got == [[0], [1], [2]]
+
+    def test_corruption_detected(self, tmp_path):
+        p = tmp_path / "c.tfrecord"
+        write_tfrecord_file(p, [b"payload"], None)
+        raw = bytearray(p.read_bytes())
+        raw[14] ^= 0xFF  # flip a payload byte
+        p.write_bytes(bytes(raw))
+        with pytest.raises(IOError, match="crc mismatch"):
+            list(read_tfrecord_records(p, None))
+
+
+class TestColumnarShards:
+    def test_shards_and_sidecar(self, tmp_path):
+        cols = {
+            "user_id": np.arange(20, dtype=np.int64),
+            "label": (np.arange(20) % 2).astype(np.int64),
+            "rating": np.linspace(0, 1, 20).astype(np.float32),
+        }
+        paths = write_tfrecord_shards(cols, tmp_path, "train", file_num=4)
+        assert len(paths) == 4
+        assert read_size_sidecar(tmp_path, "train") == 20
+        back = read_tfrecord_columns(paths)
+        assert sorted(back["user_id"].tolist()) == list(range(20))
+        np.testing.assert_allclose(np.sort(back["rating"]), np.sort(cols["rating"]), rtol=1e-6)
+
+
+class TestNativeShuffle:
+    def test_permutation_exact(self):
+        lib = load_native()
+        if lib is None:
+            pytest.skip("native toolchain unavailable")
+        import ctypes
+
+        rows = np.arange(1000, dtype=np.int64).reshape(250, 4).copy()
+        before = rows.copy()
+        buf = rows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        lib.tdfo_shuffle_rows(buf, 250, rows.strides[0], 1234)
+        # same multiset of rows, different order
+        assert sorted(map(tuple, rows)) == sorted(map(tuple, before))
+        assert not np.array_equal(rows, before)
+        # deterministic for a fixed seed
+        rows2 = before.copy()
+        lib.tdfo_shuffle_rows(rows2.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                              250, rows2.strides[0], 1234)
+        np.testing.assert_array_equal(rows, rows2)
+
+
+def test_native_builds():
+    assert native_available(), "g++ toolchain is in this image; build must work"
+
+
+class TestTFRecordStream:
+    @pytest.fixture(scope="class")
+    def tfr_dir(self, tmp_path_factory):
+        from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+        from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+
+        d = tmp_path_factory.mktemp("gr_tfr")
+        write_synthetic_goodreads(d, n_users=60, n_books=100,
+                                  interactions_per_user=(12, 30), seed=5)
+        size_map = run_ctr_preprocessing(d, write_format="tfrecord", file_num=4)
+        return d, size_map
+
+    def test_stream_reads_all_rows(self, tfr_dir):
+        from tdfo_tpu.data.loader import TFRecordStream, resolve_files
+
+        d, _ = tfr_dir
+        files = resolve_files(d, "tfrecord/train_part_*.tfrecord")
+        assert len(files) == 4
+        stream = TFRecordStream(files, batch_size=32, buffer_size=64,
+                                drop_last=False, process_index=0, process_count=1)
+        rows = sum(len(b["user_id"]) for b in stream)
+        assert rows == read_size_sidecar(d / "tfrecord", "train")
+        b = next(iter(stream))
+        assert {"user_id", "item_id", "label", "avg_rating"} <= set(b)
+
+    def test_stream_trains_twotower(self, tfr_dir):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from tdfo_tpu.data.loader import TFRecordStream, resolve_files
+        from tdfo_tpu.models.twotower import init_twotower
+        from tdfo_tpu.train.state import TrainState, make_adamw
+        from tdfo_tpu.train.step import make_train_step
+
+        d, size_map = tfr_dir
+        files = resolve_files(d, "tfrecord/train_part_*.tfrecord")
+        model, params = init_twotower(jax.random.key(0), size_map, 8)
+        state = TrainState.create(apply_fn=model.apply, params=params,
+                                  tx=make_adamw(3e-3, 1e-4))
+        step = make_train_step(donate_state=False)
+        losses = []
+        for b in TFRecordStream(files, batch_size=64, buffer_size=256,
+                                drop_last=True, process_index=0, process_count=1):
+            batch = {k: jnp.asarray(v) for k, v in b.items()}
+            batch["label"] = batch["label"].astype(jnp.float32)
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        assert losses and np.isfinite(losses).all()
+
+
+def test_shard_sizes_sidecar_many_shards(tmp_path):
+    # regression: >= 10 shards used to misorder row counts lexicographically
+    from tdfo_tpu.data.loader import TFRecordStream
+    from tdfo_tpu.data.tfrecord import read_shard_sizes
+
+    cols = {"x": np.arange(100, dtype=np.int64)}
+    paths = write_tfrecord_shards(cols, tmp_path, "train", file_num=16,
+                                  compression=None)
+    sizes = read_shard_sizes(tmp_path, "train")
+    assert sum(sizes.values()) == 100
+    stream = TFRecordStream([str(p) for p in paths], batch_size=1,
+                            compression=None, drop_last=False,
+                            process_index=0, process_count=1)
+    for p in paths:
+        assert stream._file_row_count(str(p)) == sizes[p.name]
+
+
+def test_encode_empty_float_column_keeps_dtype():
+    # regression: empty sequences fell into the int64 branch
+    rows = [
+        decode_example(encode_example({"f": np.asarray([], np.float32)})),
+        decode_example(encode_example({"f": np.asarray([1.5], np.float32)})),
+    ]
+    assert rows[0]["f"].dtype == np.float32
+    assert rows[1]["f"].dtype == np.float32
+
+
+def test_trainer_trains_on_tfrecord(tmp_path):
+    from tdfo_tpu.core.config import read_configs
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.train.trainer import Trainer
+
+    d = tmp_path / "gr"
+    write_synthetic_goodreads(d, n_users=60, n_books=100,
+                              interactions_per_user=(12, 30), seed=6)
+    size_map = run_ctr_preprocessing(d, write_format="tfrecord", file_num=4)
+    cfg = read_configs(
+        None, data_dir=d, model="twotower", write_format="tfrecord",
+        n_epochs=1, learning_rate=3e-3, embed_dim=8,
+        per_device_train_batch_size=16, per_device_eval_batch_size=16,
+        shuffle_buffer_size=500, log_every_n_steps=1000, size_map=size_map,
+    )
+    metrics = Trainer(cfg, log_dir=tmp_path / "logs").fit()
+    assert 0.0 <= metrics["auc"] <= 1.0 and np.isfinite(metrics["eval_loss"])
