@@ -116,14 +116,20 @@ class Bert4Rec(nn.Module):
 
     cfg: Bert4RecConfig
     dtype: jnp.dtype = jnp.float32
+    # same init as the DMP path's EmbeddingSpec(init_scale=1.0) — torchrec's
+    # weight_init_min/max = -1/1 — so the two regimes are init-equivalent
+    init_scale: float = 1.0
 
     @nn.compact
     def __call__(self, item_ids: jax.Array, *, deterministic: bool = True) -> jax.Array:
+        scale = self.init_scale
         emb = nn.Embed(
             self.cfg.vocab_size,
             self.cfg.embed_dim,
             dtype=self.dtype,
-            embedding_init=jax.nn.initializers.normal(0.02),
+            embedding_init=lambda key, shape, dtype: jax.random.uniform(
+                key, shape, dtype, minval=-scale, maxval=scale
+            ),
             name="item_embed",
         )
         h = emb(item_ids)

@@ -42,8 +42,17 @@ def dedupe_grads(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Merge duplicate row ids: ``(ids[B], grads[B,D]) -> (uids[U], g[U,D], valid[U])``.
 
-    ``capacity`` is the static unique bound (defaults to ``B``).  Negative
-    (padding) ids are remapped to an out-of-bounds sentinel *before* the
+    ``capacity`` is the static unique bound (defaults to ``B``).  It MUST be
+    >= the true distinct-id count: ``jnp.unique(size=...)`` truncates the
+    tail, and the searchsorted below maps every truncated id to index
+    ``capacity``, whose update the scatter silently drops — undersizing loses
+    gradient mass without error.  The default ``capacity=B`` is always safe;
+    pass a smaller value only with a proven bound (e.g. a vocab smaller than
+    the batch).  On CPU backends (tests, spoofed meshes) a runtime tripwire
+    warns when the bound is violated; it is compiled out on TPU because the
+    tunnelled runtime rejects host callbacks.
+
+    Negative (padding) ids are remapped to an out-of-bounds sentinel *before* the
     unique so sortedness holds for the searchsorted below; sentinel slots get
     a False mask, zeroed grad rows, and their scatters dropped (mode="drop"),
     so they can never collide with a real row update.  The sentinel is the
@@ -57,6 +66,21 @@ def dedupe_grads(
     uids = jnp.unique(clean, size=capacity, fill_value=oob)  # sorted, oob last
     valid = uids < oob
     seg = jnp.searchsorted(uids, clean)
+    if capacity < b and jax.default_backend() == "cpu":
+        # Truncated REAL ids are exactly those searchsorted maps to index
+        # ``capacity`` (the sentinel lands on a sentinel slot, not past the
+        # end, so it never false-positives).  debug.print needs host
+        # callbacks, which the tunnelled TPU runtime lacks — CPU-only.
+        overflow = ((seg == capacity) & (clean < oob)).any()
+        jax.lax.cond(
+            overflow,
+            lambda: jax.debug.print(
+                "WARNING dedupe_grads: distinct ids exceed capacity "
+                f"({capacity}); largest-id updates are being DROPPED",
+                ordered=False,
+            ),
+            lambda: None,
+        )
     g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
     g = jnp.where(valid[:, None], g, 0.0)
     return uids, g, valid

@@ -68,12 +68,15 @@ def ring_attention(
         )
         logits = jnp.where(kv_valid[:, None, None, :], logits, _NEG_INF)
         m_new = jnp.maximum(m, logits.max(axis=-1))  # [B, H, Tq]
-        # guard: rows where everything so far is masked keep m at -inf;
-        # exp(-inf - -inf) would be NaN, so clamp the shift.
-        shift = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        # guard: rows where everything so far is masked keep m at _NEG_INF
+        # (finite finfo.min, same convention as the flash kernel); shifting by
+        # it would overflow exp, so clamp the shift and zero the correction.
+        # Threshold at _NEG_INF/2 so the guard holds for any all-masked row
+        # regardless of whether _NEG_INF is finite or a true -inf.
+        shift = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
         probs = jnp.exp(logits - shift[..., None])
         probs = jnp.where(kv_valid[:, None, None, :], probs, 0.0)
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - shift))
+        corr = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - shift))
         l_new = l * corr + probs.sum(axis=-1)
         o_new = o * corr[..., None] + jnp.einsum(
             "bhts,bhsd->bhtd", probs.astype(v_blk.dtype), v_blk
