@@ -94,9 +94,22 @@ class Config:
     # --- parallelism (L3) ---
     model_parallel: bool = False
     embedding_sharding: str = "row"  # "row" | "column" | "table" | "replicated"
+    # embedding-lookup program (parallel/embedding.py): "gspmd" (compiler
+    # schedules the collectives), "psum" (explicit shard_map, one psum), or
+    # "alltoall" (torchrec input-dist/output-dist parity, 2 collectives)
+    lookup_mode: str = "gspmd"
+    # attention core for sequence models: "full" (T x T), "ring"
+    # (sequence-parallel over the seq mesh axis), "flash" (Pallas O(T) kernel)
+    attn: str = "full"
+    # route sparse-Adam updates through the fused Pallas kernel
+    # (ops/pallas_kernels.sparse_adam_rows)
+    use_pallas: bool = False
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---
+    # compiled multi-step loop: each device dispatch runs this many train
+    # steps (tensorflow2/utils.py steps_per_execution parity; a real TPU win
+    # because per-step host round trips disappear)
     steps_per_execution: int = 1
     jit_xla: bool | None = None
     use_tpu: bool = False
@@ -117,6 +130,14 @@ class Config:
             raise ValueError(f"unsupported write_format: {self.write_format!r}")
         if self.embedding_sharding not in ("row", "column", "table", "replicated"):
             raise ValueError(f"unknown embedding_sharding: {self.embedding_sharding!r}")
+        if self.lookup_mode not in ("gspmd", "psum", "alltoall"):
+            raise ValueError(f"unknown lookup_mode: {self.lookup_mode!r}")
+        if self.attn not in ("full", "ring", "flash"):
+            raise ValueError(f"unknown attn: {self.attn!r}")
+        if self.steps_per_execution < 1:
+            raise ValueError("steps_per_execution must be >= 1")
+        if not self.streaming and self.write_format != "parquet":
+            raise ValueError("streaming=false (map-style) requires parquet data")
 
     @property
     def global_train_batch_size(self) -> int:

@@ -38,6 +38,7 @@ import pyarrow.parquet as pq
 __all__ = [
     "ParquetStream",
     "TFRecordStream",
+    "MapStream",
     "load_parquet_table",
     "permutation_batches",
     "prefetch_to_mesh",
@@ -334,6 +335,46 @@ def permutation_batches(
     end = n - n % batch_size if drop_last else n
     for i in range(0, end, batch_size):
         yield _take(data, idx[i : i + batch_size])
+
+
+class MapStream:
+    """Map-style epochs over an in-memory table, presenting the same
+    interface as :class:`ParquetStream` (``config streaming = false``;
+    ``jax-flax/train.py:52-70`` full-permutation loader parity).
+
+    Single-process only: the whole table lives on this host, so multi-host
+    budget logic does not apply (use the streaming loader on pods).
+    """
+
+    def __init__(self, files: Sequence[str], batch_size: int, *,
+                 shuffle: bool = True, seed: int = 42, drop_last: bool = True,
+                 columns: Sequence[str] | None = None):
+        import jax
+
+        if jax.process_count() > 1:
+            raise ValueError(
+                "streaming=false (map-style) loading is single-process only; "
+                "multi-host runs need the streaming loader's per-host budgets"
+            )
+        self.table = load_parquet_table(files, columns)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._n = len(next(iter(self.table.values())))
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = int(epoch)
+
+    def max_batches_per_host(self) -> int:
+        return -(-self._n // self.batch_size)
+
+    def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
+        yield from permutation_batches(
+            self.table, self.batch_size, shuffle=self.shuffle, seed=self.seed,
+            epoch=self._epoch, drop_last=self.drop_last,
+        )
 
 
 def prefetch_to_mesh(it, mesh, pspec=None, *, size: int = 2):

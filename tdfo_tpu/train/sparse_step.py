@@ -63,6 +63,7 @@ def make_sparse_train_step(
     *,
     mode: str = "gspmd",
     donate: bool = True,
+    jit: bool = True,
 ):
     """Build the jitted hybrid step.
 
@@ -135,4 +136,6 @@ def make_sparse_train_step(
             loss,
         )
 
+    if not jit:
+        return step
     return jax.jit(step, donate_argnums=(0,) if donate else ())

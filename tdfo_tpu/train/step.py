@@ -26,7 +26,7 @@ from tdfo_tpu.core.mesh import DATA_AXIS
 from tdfo_tpu.core.precision import scale_loss, unscale_grads
 from tdfo_tpu.train.state import TrainState
 
-__all__ = ["bce_with_logits_loss", "make_train_step", "make_eval_step"]
+__all__ = ["bce_with_logits_loss", "make_train_step", "make_eval_step", "make_multi_step"]
 
 
 def bce_with_logits_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -40,6 +40,7 @@ def make_train_step(
     *,
     mesh: Mesh | None = None,
     donate_state: bool = True,
+    jit: bool = True,
 ):
     """Build the jitted train step.
 
@@ -85,8 +86,36 @@ def make_train_step(
             )
         return new_state, loss
 
+    if not jit:
+        return step
     donate = (0,) if donate_state else ()
     return jax.jit(step, donate_argnums=donate)
+
+
+def make_multi_step(step_fn: Callable, *, donate_state: bool = True):
+    """Compile a ``steps_per_execution`` loop into ONE device dispatch.
+
+    TF parity (``tensorflow2/utils.py:10-38`` ``steps_per_execution`` ->
+    ``model.compile``): ``multi(state, stack, *rest)`` scans ``step_fn`` (an
+    UNJITTED step from a factory called with ``jit=False``) over a stacked
+    batch pytree (leading axis = steps), returning the final state and the
+    mean loss over the chunk.  Host round trips per step vanish; XLA overlaps
+    the scan body's transfers and compute.
+
+    ``*rest`` (e.g. the dropout rng of the sparse step) is closed over
+    per-chunk; steps stay distinct because the step folds the rng with the
+    step counter.
+    """
+
+    def multi(state, stack, *rest):
+        def body(st, batch):
+            st, loss = step_fn(st, batch, *rest)
+            return st, loss
+
+        state, losses = jax.lax.scan(body, state, stack)
+        return state, losses.mean()
+
+    return jax.jit(multi, donate_argnums=(0,) if donate_state else ())
 
 
 def _default_loss(params, apply_fn, batch):

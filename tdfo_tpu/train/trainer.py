@@ -38,14 +38,14 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tdfo_tpu.core.config import Config
 from tdfo_tpu.core.mesh import make_mesh
 from tdfo_tpu.data.loader import (
+    MapStream,
     ParquetStream,
-    load_parquet_table,
     prefetch_to_mesh,
     resolve_files,
 )
 from tdfo_tpu.train.metrics import AUC, recalls_and_ndcgs_for_ks
 from tdfo_tpu.train.state import TrainState, make_adamw
-from tdfo_tpu.train.step import make_eval_step, make_train_step
+from tdfo_tpu.train.step import make_eval_step, make_multi_step, make_train_step
 
 __all__ = ["Trainer", "MetricLogger", "pad_batch"]
 
@@ -101,6 +101,7 @@ class Trainer:
         self.mesh = make_mesh(config.mesh)
         self.logger = MetricLogger(log_dir or config.checkpoint_dir)
         self._ckpt = None
+        self._map_streams: dict = {}  # streaming=false table cache
         if config.checkpoint_dir:
             from tdfo_tpu.train.checkpoint import CheckpointManager
 
@@ -146,7 +147,12 @@ class Trainer:
             else (lambda path, leaf: P())
         )
         self.state = shard_state(state, self.mesh, rule)
-        self.train_step = make_train_step(mesh=self.mesh)
+        if cfg.steps_per_execution > 1:
+            self.train_step = make_multi_step(
+                make_train_step(mesh=self.mesh, jit=False)
+            )
+        else:
+            self.train_step = make_train_step(mesh=self.mesh)
         self.eval_step = make_eval_step(mesh=self.mesh)
         if cfg.write_format == "tfrecord":
             from tdfo_tpu.data.loader import TFRecordStream
@@ -180,19 +186,31 @@ class Trainer:
         )
         sharding = cfg.embedding_sharding if cfg.model_parallel else "replicated"
         self.coll, tables, self.backbone, dense = make_sharded_bert4rec(
-            jax.random.key(cfg.seed), self.model_cfg, self.mesh, sharding=sharding
+            jax.random.key(cfg.seed), self.model_cfg, self.mesh,
+            sharding=sharding, attn=cfg.attn,
         )
         self.state = SparseTrainState.create(
             dense_params=dense,
             tx=optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
             tables=tables,
             sparse_opt=sparse_optimizer(
-                "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay
+                "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
+                use_pallas=cfg.use_pallas,
             ),
         )
-        self.train_step = make_sparse_train_step(
-            self.coll, bert4rec_sparse_forward(self.backbone), donate=False
-        )
+        if cfg.steps_per_execution > 1:
+            self.train_step = make_multi_step(
+                make_sparse_train_step(
+                    self.coll, bert4rec_sparse_forward(self.backbone),
+                    mode=cfg.lookup_mode, jit=False,
+                ),
+                donate_state=False,
+            )
+        else:
+            self.train_step = make_sparse_train_step(
+                self.coll, bert4rec_sparse_forward(self.backbone),
+                mode=cfg.lookup_mode, donate=False,
+            )
         self._dropout_rng = jax.random.key(cfg.seed + 1)
         self._stream_cls = ParquetStream  # seq ETL writes parquet only
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
@@ -200,56 +218,100 @@ class Trainer:
 
     # --------------------------------------------------------------- epochs
 
-    def _stream(self, pattern: str, *, train: bool) -> ParquetStream:
+    def _stream(self, pattern: str, *, train: bool):
         cfg = self.config
         files = resolve_files(cfg.data_dir, pattern)
         # each host streams only its local slice of the global batch: the
         # data axis spans every host's devices, and prefetch_to_mesh
         # assembles the global array from per-process chunks.
         local_data = max(1, self.mesh.shape["data"] // jax.process_count())
+        bsz = (cfg.per_device_train_batch_size if train
+               else cfg.per_device_eval_batch_size) * local_data
+        if not cfg.streaming:
+            # map-style in-memory epochs (config streaming=false,
+            # jax-flax/train.py:52-70 parity); table cached across epochs
+            key = (pattern, bsz, train)
+            if key not in self._map_streams:
+                self._map_streams[key] = MapStream(
+                    files, batch_size=bsz, shuffle=train, seed=cfg.seed,
+                    drop_last=train,
+                )
+            return self._map_streams[key]
         return self._stream_cls(
             files,
-            batch_size=(cfg.per_device_train_batch_size if train
-                        else cfg.per_device_eval_batch_size) * local_data,
+            batch_size=bsz,
             shuffle=train,
             buffer_size=cfg.shuffle_buffer_size,
             seed=cfg.seed,
             drop_last=train,
         )
 
-    def _train_batches(self, epoch: int) -> Iterator[dict]:
+    def _train_batches(self, epoch: int) -> Iterator[tuple[dict, int]]:
+        """Yields ``(device_batch, n_steps_in_batch)``.
+
+        With ``steps_per_execution > 1`` host batches are stacked into
+        [K, B, ...] chunks and the whole chunk ships as one transfer feeding
+        one compiled multi-step dispatch; a short tail chunk recompiles at
+        most once per distinct K.
+        """
+        cfg = self.config
         stream = self._stream(self._train_pattern, train=True)
         stream.set_epoch(epoch)
-        if self.config.model == "bert4rec":
+        if cfg.model == "bert4rec":
             renamed = (
                 {"item": b["train_interactions"], "label": b["labels"]} for b in stream
             )
         else:
             renamed = iter(stream)
-        yield from prefetch_to_mesh(renamed, self.mesh, P("data"))
+        spe = cfg.steps_per_execution
+        if spe <= 1:
+            for batch in prefetch_to_mesh(renamed, self.mesh, P("data")):
+                yield batch, 1
+            return
+
+        def stacked():
+            chunk: list[dict] = []
+            for b in renamed:
+                chunk.append(b)
+                if len(chunk) == spe:
+                    yield {k: np.stack([c[k] for c in chunk]) for k in chunk[0]}
+                    chunk = []
+            if chunk:
+                yield {k: np.stack([c[k] for c in chunk]) for k in chunk[0]}
+
+        for stack in prefetch_to_mesh(stacked(), self.mesh, P(None, "data")):
+            yield stack, int(next(iter(stack.values())).shape[0])
 
     def train_epoch(self, epoch: int) -> float:
         cfg = self.config
         t0 = time.perf_counter()
-        losses, n_steps = 0.0, 0
+        # loss accumulates ON DEVICE; the only host syncs are at log
+        # boundaries and epoch end (a per-step float() would serialise
+        # dispatch and defeat the double-buffered prefetch).
+        loss_sum = None
+        n_steps = 0
+        next_log = cfg.log_every_n_steps
         profiled = cfg.profile and epoch == 0 and jax.process_index() == 0
-        for batch in self._train_batches(epoch):
-            if profiled and n_steps == 10:
+        for batch, k in self._train_batches(epoch):
+            if profiled is True and n_steps >= 10:
                 jax.profiler.start_trace(str(Path(cfg.checkpoint_dir or ".") / "profile"))
+                profiled = "tracing"
             if cfg.model == "bert4rec":
                 self.state, loss = self.train_step(self.state, batch, self._dropout_rng)
             else:
                 self.state, loss = self.train_step(self.state, batch)
-            n_steps += 1
-            if profiled and n_steps == 20:
+            n_steps += k
+            loss_k = loss * k  # chunk mean -> chunk sum (k=1: identity)
+            loss_sum = loss_k if loss_sum is None else loss_sum + loss_k
+            if profiled == "tracing" and n_steps >= 20:
                 jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
                 profiled = False
-            if n_steps % cfg.log_every_n_steps == 0:
+            if n_steps >= next_log:
                 self.logger.log(epoch=epoch, step=n_steps, train_loss=float(loss))
-            losses += float(loss)
+                next_log += cfg.log_every_n_steps
         dt = time.perf_counter() - t0
-        avg = losses / max(n_steps, 1)
+        avg = float(loss_sum) / n_steps if n_steps else 0.0
         self.logger.log(
             epoch=epoch, train_loss_epoch=avg, steps=n_steps,
             examples_per_sec=n_steps * cfg.per_device_train_batch_size

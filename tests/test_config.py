@@ -78,3 +78,21 @@ def test_mesh_table(tmp_path: Path):
     (tmp_path / "config.toml").write_text("[mesh]\ndata = 4\nmodel = 2\nseq = 1\n")
     cfg = read_configs(tmp_path / "config.toml")
     assert cfg.mesh.sizes() == (4, 2, 1)
+
+
+def test_new_knob_validation():
+    import pytest as _pytest
+
+    from tdfo_tpu.core.config import Config
+
+    for bad in (
+        dict(lookup_mode="nccl"),
+        dict(attn="linear"),
+        dict(steps_per_execution=0),
+        dict(streaming=False, write_format="tfrecord"),
+    ):
+        with _pytest.raises(ValueError):
+            Config(**bad)
+    # valid combinations construct fine
+    Config(lookup_mode="alltoall", attn="ring", use_pallas=True,
+           steps_per_execution=4, streaming=False)

@@ -132,3 +132,71 @@ log_every_n_steps = 1000
                  "--log-dir", str(tmp_path / "logs")]) == 0
     out = capsys.readouterr().out
     assert "auc" in out
+
+
+def test_steps_per_execution_matches_single_step(prepared_dir, tmp_path):
+    """The compiled multi-step loop must train identically to per-step
+    dispatch (tensorflow2 steps_per_execution parity) — same data order,
+    same math, just one dispatch per K steps."""
+    d, ctr, _ = prepared_dir
+    common = dict(
+        data_dir=d, model="twotower", learning_rate=3e-3, embed_dim=8,
+        per_device_train_batch_size=16, per_device_eval_batch_size=16,
+        shuffle_buffer_size=500, log_every_n_steps=1000, size_map=ctr,
+        n_epochs=1,
+    )
+    tr1 = Trainer(read_configs(None, **common))
+    avg1 = tr1.train_epoch(0)
+    tr4 = Trainer(read_configs(None, steps_per_execution=4, **common))
+    avg4 = tr4.train_epoch(0)
+    assert np.isclose(avg1, avg4, rtol=1e-4), (avg1, avg4)
+    e1, e4 = tr1.evaluate(0), tr4.evaluate(0)
+    assert np.isclose(e1["eval_loss"], e4["eval_loss"], rtol=1e-4)
+
+
+def test_twotower_map_style_loader(prepared_dir, tmp_path):
+    """config streaming=false -> in-memory map-style epochs (jax-flax
+    train.py data_loader parity) through the same trainer."""
+    d, ctr, _ = prepared_dir
+    cfg = read_configs(
+        None, data_dir=d, model="twotower", streaming=False, n_epochs=1,
+        learning_rate=3e-3, embed_dim=8, per_device_train_batch_size=16,
+        per_device_eval_batch_size=16, log_every_n_steps=1000, size_map=ctr,
+    )
+    tr = Trainer(cfg, log_dir=tmp_path)
+    metrics = tr.fit()
+    assert 0.0 <= metrics["auc"] <= 1.0
+
+
+def test_bert4rec_config_wired_islands(prepared_dir, tmp_path):
+    """attn/lookup_mode/use_pallas/steps_per_execution are reachable from
+    Config: flash attention (interpret on CPU), psum lookup program over a
+    2-shard model axis, Pallas sparse Adam, 2-step compiled loop."""
+    d, _, seq = prepared_dir
+    cfg = read_configs(
+        None,
+        data_dir=d,
+        model="bert4rec",
+        model_parallel=True,
+        attn="flash",
+        lookup_mode="psum",
+        use_pallas=True,
+        steps_per_execution=2,
+        mesh={"data": 4, "model": 2},
+        n_epochs=1,
+        learning_rate=3e-3,
+        embed_dim=16,
+        n_heads=2,
+        n_layers=1,
+        max_len=12,
+        sliding_step=6,
+        per_device_train_batch_size=8,
+        per_device_eval_batch_size=8,
+        shuffle_buffer_size=1000,
+        log_every_n_steps=1000,
+        size_map={"n_items": seq["n_items"]},
+    )
+    tr = Trainer(cfg, log_dir=tmp_path)
+    metrics = tr.fit()
+    for v in metrics.values():
+        assert 0.0 <= v <= 1.0
