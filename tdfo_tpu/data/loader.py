@@ -368,6 +368,9 @@ class MapStream:
         self._epoch = int(epoch)
 
     def max_batches_per_host(self) -> int:
+        # must mirror the __iter__ count exactly: drop_last floors, else ceils
+        if self.drop_last:
+            return self._n // self.batch_size
         return -(-self._n // self.batch_size)
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:

@@ -1,0 +1,88 @@
+"""DLRM-style CTR model — feature-interaction head over sharded tables.
+
+The reference contains no DLRM, but the driver's north star
+(``/root/repo/BASELINE.json``: DLRM-Criteo examples/sec/chip, >=1B-row
+row-sharded embeddings) names the DLRM recipe as the CTR scaling target.  This
+module is the standard DLRM architecture (bottom MLP over dense features,
+pairwise dot-product interactions between all embedding vectors and the
+bottom output, top MLP over [bottom, interactions]) expressed TPU-first:
+
+  * it consumes *gathered* embedding vectors — the tables are declared with
+    :func:`tdfo_tpu.models.twotower.ctr_embedding_specs` and live in a
+    :class:`~tdfo_tpu.parallel.embedding.ShardedEmbeddingCollection`, so the
+    model always runs in the DMP regime (``make_sparse_train_step``:
+    row-sparse in-backward optimizer, per-step traffic O(batch) not O(vocab));
+  * the interaction is one batched ``einsum`` ([B, F, D] x [B, F, D] ->
+    [B, F, F]) — a single MXU-shaped contraction instead of per-pair ops;
+  * all layers run in the compute dtype policy (bf16 on TPU), params f32.
+
+Feature set matches the CTR pipeline (7 categorical + 2 continuous,
+``jax-flax/preprocessing.py`` schema) so DLRM trains from the exact same
+preprocessed data as TwoTower.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from tdfo_tpu.models.twotower import (
+    TWOTOWER_CATEGORICAL,
+    TWOTOWER_CONTINUOUS,
+    _FEATURE_TO_INPUT,
+)
+
+__all__ = ["DLRMBackbone"]
+
+
+class DLRMBackbone(nn.Module):
+    """Bottom MLP -> pairwise dot interactions -> top MLP -> [B] logits.
+
+    ``embs``: gathered vectors keyed by input-column name (one [B, D] array
+    per categorical feature); ``batch`` supplies the continuous columns.
+    """
+
+    embed_dim: int
+    bottom_dims: tuple[int, ...] = (64,)
+    top_dims: tuple[int, ...] = (128, 64)
+    dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = jax.nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(
+        self, embs: Mapping[str, jax.Array], batch: Mapping[str, jax.Array]
+    ) -> jax.Array:
+        # bottom MLP over the continuous features, projected to embed_dim so
+        # it joins the interaction as an (F+1)-th vector (standard DLRM).
+        x = jnp.stack(
+            [batch[c].astype(self.dtype) for c in TWOTOWER_CONTINUOUS], axis=-1
+        )  # [B, C]
+        for i, width in enumerate(self.bottom_dims):
+            x = nn.Dense(width, dtype=self.dtype, kernel_init=self.kernel_init,
+                         name=f"bottom_{i}")(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.embed_dim, dtype=self.dtype, kernel_init=self.kernel_init,
+                     name="bottom_out")(x)
+        x = nn.relu(x)  # [B, D]
+
+        vecs = jnp.stack(
+            [embs[_FEATURE_TO_INPUT[f]].astype(self.dtype) for f in TWOTOWER_CATEGORICAL]
+            + [x],
+            axis=1,
+        )  # [B, F+1, D]
+        inter = jnp.einsum("bfd,bgd->bfg", vecs, vecs)  # one MXU contraction
+        f = vecs.shape[1]
+        iu, ju = np.triu_indices(f, k=1)  # static at trace time
+        flat = inter[:, iu, ju]  # [B, F(F+1)/2 - F] upper-triangle pairs
+
+        top = jnp.concatenate([x, flat], axis=-1)
+        for i, width in enumerate(self.top_dims):
+            top = nn.Dense(width, dtype=self.dtype, kernel_init=self.kernel_init,
+                           name=f"top_{i}")(top)
+            top = nn.relu(top)
+        return nn.Dense(1, dtype=self.dtype, kernel_init=self.kernel_init,
+                        name="top_out")(top)[:, 0]  # [B] logits

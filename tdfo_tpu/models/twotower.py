@@ -7,10 +7,19 @@ publisher, pub_decade) + 2 continuous features (avg_rating, num_pages);
 user tower = MLP over the user embedding; item tower = MLP over the concat of
 6 item-side embeds + scalars; score = row-wise dot product.
 
+Two usage modes mirror the torchrec DDP/DMP split (``torchrec/train.py:235-260``):
+
+  * :class:`TwoTower` owns its 7 tables as flax ``nn.Embed`` modules — the
+    replicated/data-parallel regime (one param tree, dense AdamW), matching
+    the reference recipes directly.
+  * :class:`TwoTowerBackbone` consumes *already gathered* embedding vectors;
+    the tables live in a :class:`~tdfo_tpu.parallel.embedding.ShardedEmbeddingCollection`
+    declared by :func:`ctr_embedding_specs` — the DMP-equivalent regime, used
+    with ``make_sparse_train_step`` (row-sparse in-backward optimizer, tables
+    sharded over the ``model`` mesh axis).  This is the path that scales to
+    >=1B-row tables: per-step HBM traffic is O(batch rows), not O(vocab).
+
 TPU-first departures from the reference:
-  * tables are declared through :class:`EmbeddingSpec` so they can be
-    GSPMD-sharded over the ``model`` mesh axis (torchrec-DMP equivalent) —
-    the dense towers stay replicated, exactly the torchrec split.
   * compute dtype is a policy (bf16 on TPU) while params stay f32; the
     reference instead cast whole modules (``jax-flax/models.py:122-124``).
   * towers are fused into single batched matmuls (the two hidden layers per
@@ -19,13 +28,21 @@ TPU-first departures from the reference:
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Mapping
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-__all__ = ["TwoTower", "TWOTOWER_CATEGORICAL", "TWOTOWER_CONTINUOUS", "init_twotower"]
+__all__ = [
+    "TwoTower",
+    "TwoTowerBackbone",
+    "TWOTOWER_CATEGORICAL",
+    "TWOTOWER_CONTINUOUS",
+    "init_twotower",
+    "ctr_embedding_specs",
+]
 
 # item-side categorical features, concat order fixed for parity with
 # jax-flax/models.py:89-101
@@ -98,6 +115,61 @@ class TwoTower(nn.Module):
         parts = [self.embeds[f](x[_FEATURE_TO_INPUT[f]]) for f in TWOTOWER_ITEM_CATEGORICAL]
         parts += [x[c].astype(self.dtype)[:, None] for c in TWOTOWER_CONTINUOUS]
         return self.item_tower(jnp.concatenate(parts, axis=-1))
+
+
+class TwoTowerBackbone(nn.Module):
+    """Dense half of TwoTower for the DMP regime: consumes gathered embedding
+    vectors keyed by input-column name (``user_id``, ``item_id``, ...) plus
+    the raw batch for continuous features.  Tables live outside the module in
+    a ShardedEmbeddingCollection; pairs with ``make_sparse_train_step``."""
+
+    embed_dim: int
+    activation: Callable = jax.nn.swish
+    dtype: jnp.dtype = jnp.float32
+    kernel_init: Callable = jax.nn.initializers.glorot_uniform()
+
+    @nn.compact
+    def __call__(
+        self, embs: Mapping[str, jax.Array], batch: Mapping[str, jax.Array]
+    ) -> jax.Array:
+        u = Tower(
+            self.embed_dim, self.activation, self.dtype,
+            kernel_init=self.kernel_init, name="user_tower",
+        )(embs[_FEATURE_TO_INPUT["user"]].astype(self.dtype))
+        parts = [
+            embs[_FEATURE_TO_INPUT[f]].astype(self.dtype)
+            for f in TWOTOWER_ITEM_CATEGORICAL
+        ]
+        parts += [batch[c].astype(self.dtype)[:, None] for c in TWOTOWER_CONTINUOUS]
+        v = Tower(
+            self.embed_dim, self.activation, self.dtype,
+            kernel_init=self.kernel_init, name="item_tower",
+        )(jnp.concatenate(parts, axis=-1))
+        return jnp.einsum("be,be->b", u, v)  # [B] logits
+
+
+def ctr_embedding_specs(
+    size_map: Mapping[str, int], embed_dim: int, sharding: str = "row"
+):
+    """Declare the 7 CTR tables for a ShardedEmbeddingCollection.
+
+    Table ``{feat}_embed`` serves the corresponding input column; init is
+    uniform with the glorot bound ``sqrt(6 / (V + D))`` so the DMP regime is
+    init-equivalent to the dense regime's ``nn.Embed`` glorot tables.
+    """
+    from tdfo_tpu.parallel.embedding import EmbeddingSpec
+
+    return [
+        EmbeddingSpec(
+            name=f"{feat}_embed",
+            num_embeddings=int(size_map[feat]),
+            embedding_dim=embed_dim,
+            features=(_FEATURE_TO_INPUT[feat],),
+            sharding=sharding,
+            init_scale=math.sqrt(6.0 / (int(size_map[feat]) + embed_dim)),
+        )
+        for feat in TWOTOWER_CATEGORICAL
+    ]
 
 
 def dummy_batch(batch_size: int = 1) -> dict[str, jnp.ndarray]:

@@ -108,10 +108,10 @@ class ShardedEmbeddingCollection:
             for s in self._table_wise:
                 by_dim.setdefault(s.embedding_dim, []).append(s)
             for dim, group in by_dim.items():
-                if len({(s.dtype, s.init_scale) for s in group}) > 1:
+                if len({s.dtype for s in group}) > 1:
                     raise ValueError(
                         "table-wise tables stacked into one array must share "
-                        f"dtype and init_scale; got {[(s.name, s.dtype, s.init_scale) for s in group]}"
+                        f"a dtype; got {[(s.name, s.dtype) for s in group]}"
                     )
                 # shard slot i holds tables i, i+M, i+2M, ...; pad every slot
                 # to the max slot height so boundaries align with shards.
@@ -172,10 +172,16 @@ class ShardedEmbeddingCollection:
         for gname, group in self._groups.items():
             total = self._stack_rows[group[0].name][1]
             dim = group[0].embedding_dim
-            t = jax.random.uniform(
-                next(key_iter), (total, dim), group[0].dtype,
-                minval=-group[0].init_scale, maxval=group[0].init_scale,
-            )
+            # each member table keeps its own init scale (slice-wise draws);
+            # padding rows stay zero — valid storage, never referenced.
+            t = jnp.zeros((total, dim), group[0].dtype)
+            for s, k in zip(group, jax.random.split(next(key_iter), len(group))):
+                off, _ = self._stack_rows[s.name]
+                rows = jax.random.uniform(
+                    k, (s.num_embeddings, dim), s.dtype,
+                    minval=-s.init_scale, maxval=s.init_scale,
+                )
+                t = jax.lax.dynamic_update_slice(t, rows, (off, 0))
             sh = NamedSharding(self.mesh, P(self.axis, None))
             tables[gname] = jax.device_put(t, sh)
         return tables

@@ -1,0 +1,56 @@
+"""CTR training in the DMP regime: sparse forward + eval for TwoTower/DLRM.
+
+The torchrec DMP + CombinedOptimizer pattern (``torchrec/train.py:235-254``)
+applied to the CTR family: the 7 embedding tables live in a
+ShardedEmbeddingCollection and get row-sparse in-backward updates
+(``make_sparse_train_step``); the dense towers / MLPs stay under optax.  This
+is what eliminates the dense-AdamW full-table optimizer sweep — per-step HBM
+traffic becomes O(batch rows), making >=1B-row tables feasible (SURVEY.md §7
+hard part #2, BASELINE.json north star).
+
+Adapters here mirror ``tdfo_tpu/train/seq.py`` for the sequential family.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+from tdfo_tpu.train.step import bce_with_logits_loss
+
+__all__ = ["ctr_sparse_forward", "make_ctr_sparse_eval_step"]
+
+
+def ctr_sparse_forward(backbone) -> Callable:
+    """Forward for ``make_sparse_train_step``: the collection has already
+    gathered the categorical vectors; run the dense backbone (TwoTowerBackbone
+    or DLRMBackbone — both take ``(embs, batch)``) and the sigmoid BCE."""
+
+    def forward(dense_params, embs, batch):
+        logits = backbone.apply({"params": dense_params}, embs, batch)
+        return bce_with_logits_loss(logits, batch["label"].astype(jnp.float32))
+
+    return forward
+
+
+def make_ctr_sparse_eval_step(
+    coll: ShardedEmbeddingCollection, backbone, *, mode: str = "gspmd"
+):
+    """Jitted eval step, (state, batch) -> (loss, logits) — same contract as
+    ``make_eval_step`` so the trainer's eval loop serves both regimes.  The
+    lookup honours the configured ``lookup_mode`` (same program as training).
+    """
+    features = list(coll.features())
+
+    @jax.jit
+    def step(state, batch):
+        ids = {f: batch[f] for f in features}
+        embs = coll.lookup(state.tables, ids, mode=mode)
+        logits = backbone.apply({"params": state.dense_params}, embs, batch)
+        loss = bce_with_logits_loss(logits, batch["label"].astype(jnp.float32))
+        return loss, logits
+
+    return step

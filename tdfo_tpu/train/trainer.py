@@ -112,17 +112,43 @@ class Trainer:
 
     def _build(self) -> None:
         cfg = self.config
-        if cfg.model == "twotower":
-            self._build_twotower()
+        if cfg.model in ("twotower", "dlrm"):
+            self._build_ctr()
         elif cfg.model == "bert4rec":
             self._build_bert4rec()
         else:
             raise ValueError(f"unknown model {cfg.model!r}")
 
-    def _build_twotower(self) -> None:
+    def _set_ctr_streams(self) -> None:
+        cfg = self.config
+        if cfg.write_format == "tfrecord":
+            from tdfo_tpu.data.loader import TFRecordStream
+
+            self._stream_cls = TFRecordStream
+            to_tfr = lambda pat: pat.replace(".parquet", ".tfrecord")
+            self._train_pattern = str(Path("tfrecord") / to_tfr(cfg.train_data))
+            self._eval_pattern = str(Path("tfrecord") / to_tfr(cfg.eval_data))
+        else:
+            self._stream_cls = ParquetStream
+            self._train_pattern = str(Path("parquet") / cfg.train_data)
+            self._eval_pattern = str(Path("parquet") / cfg.eval_data)
+
+    def _build_ctr(self) -> None:
+        """CTR family.  TwoTower without model_parallel keeps the reference's
+        dense regime (nn.Embed tables, dense AdamW).  TwoTower with
+        model_parallel — and DLRM always — run the DMP regime: tables in a
+        ShardedEmbeddingCollection with the row-sparse in-backward optimizer
+        (``torchrec/train.py:235-254`` parity, O(batch) optimizer traffic)."""
+        cfg = self.config
+        self._set_ctr_streams()
+        if cfg.model == "twotower" and not cfg.model_parallel:
+            self._build_twotower_dense()
+        else:
+            self._build_ctr_sparse()
+
+    def _build_twotower_dense(self) -> None:
         from tdfo_tpu.core.precision import DynamicLossScale, compute_dtype
         from tdfo_tpu.models.twotower import init_twotower
-        from tdfo_tpu.parallel.sharding import rowwise_embedding_rule, shard_state
 
         cfg = self.config
         dtype = compute_dtype(cfg.mixed_precision)
@@ -141,12 +167,9 @@ class Trainer:
             tx=make_adamw(cfg.learning_rate, cfg.weight_decay),
             loss_scale=loss_scale,
         )
-        rule = (
-            rowwise_embedding_rule(self.mesh)
-            if cfg.model_parallel
-            else (lambda path, leaf: P())
+        self.state = jax.device_put(
+            state, NamedSharding(self.mesh, P())
         )
-        self.state = shard_state(state, self.mesh, rule)
         if cfg.steps_per_execution > 1:
             self.train_step = make_multi_step(
                 make_train_step(mesh=self.mesh, jit=False)
@@ -154,17 +177,74 @@ class Trainer:
         else:
             self.train_step = make_train_step(mesh=self.mesh)
         self.eval_step = make_eval_step(mesh=self.mesh)
-        if cfg.write_format == "tfrecord":
-            from tdfo_tpu.data.loader import TFRecordStream
 
-            self._stream_cls = TFRecordStream
-            to_tfr = lambda pat: pat.replace(".parquet", ".tfrecord")
-            self._train_pattern = str(Path("tfrecord") / to_tfr(cfg.train_data))
-            self._eval_pattern = str(Path("tfrecord") / to_tfr(cfg.eval_data))
+    def _build_ctr_sparse(self) -> None:
+        import optax as _optax
+
+        from tdfo_tpu.core.precision import compute_dtype
+        from tdfo_tpu.models.twotower import (
+            TWOTOWER_CONTINUOUS,
+            TwoTowerBackbone,
+            ctr_embedding_specs,
+        )
+        from tdfo_tpu.ops.sparse import sparse_optimizer
+        from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+        from tdfo_tpu.train.ctr import ctr_sparse_forward, make_ctr_sparse_eval_step
+        from tdfo_tpu.train.sparse_step import SparseTrainState, make_sparse_train_step
+
+        from tdfo_tpu.models.twotower import TWOTOWER_CATEGORICAL
+
+        cfg = self.config
+        # every table's vocab must be present, not just user/item — a partial
+        # size_map should fail with this message, not a KeyError downstream
+        missing = [f for f in TWOTOWER_CATEGORICAL if f not in cfg.size_map]
+        if missing:
+            raise ValueError(
+                f"{cfg.model} needs vocab sizes {missing} in size_map (run preprocessing)"
+            )
+        dtype = compute_dtype(cfg.mixed_precision)
+        sharding = cfg.embedding_sharding if cfg.model_parallel else "replicated"
+        coll = ShardedEmbeddingCollection(
+            ctr_embedding_specs(cfg.size_map, cfg.embed_dim, sharding),
+            mesh=self.mesh,
+        )
+        k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
+        tables = coll.init(k_tables)
+        if cfg.model == "dlrm":
+            from tdfo_tpu.models.dlrm import DLRMBackbone
+
+            backbone = DLRMBackbone(embed_dim=cfg.embed_dim, dtype=dtype)
         else:
-            self._stream_cls = ParquetStream
-            self._train_pattern = str(Path("parquet") / cfg.train_data)
-            self._eval_pattern = str(Path("parquet") / cfg.eval_data)
+            backbone = TwoTowerBackbone(embed_dim=cfg.embed_dim, dtype=dtype)
+        dummy_embs = {
+            f: jnp.zeros((1, cfg.embed_dim), jnp.float32) for f in coll.features()
+        }
+        dummy_cont = {c: jnp.zeros((1,), jnp.float32) for c in TWOTOWER_CONTINUOUS}
+        dense = backbone.init(k_dense, dummy_embs, dummy_cont)["params"]
+        self.coll = coll
+        self.state = SparseTrainState.create(
+            dense_params=dense,
+            tx=_optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
+            tables=tables,
+            sparse_opt=sparse_optimizer(
+                "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
+                use_pallas=cfg.use_pallas,
+            ),
+        )
+        if cfg.steps_per_execution > 1:
+            self.train_step = make_multi_step(
+                make_sparse_train_step(
+                    coll, ctr_sparse_forward(backbone),
+                    mode=cfg.lookup_mode, jit=False,
+                ),
+                donate_state=False,
+            )
+        else:
+            self.train_step = make_sparse_train_step(
+                coll, ctr_sparse_forward(backbone),
+                mode=cfg.lookup_mode, donate=False,
+            )
+        self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
 
     def _build_bert4rec(self) -> None:
         from tdfo_tpu.models.bert4rec import Bert4RecConfig, make_sharded_bert4rec
@@ -215,6 +295,23 @@ class Trainer:
         self._stream_cls = ParquetStream  # seq ETL writes parquet only
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
         self._eval_pattern = str(Path("parquet_bert4rec") / cfg.eval_data)
+
+        # eval scorer built ONCE (a fresh jit closure per eval epoch would
+        # recompile every time) and honouring the configured lookup program
+        from tdfo_tpu.models.bert4rec import key_padding_mask
+        from tdfo_tpu.train.seq import score_candidates
+
+        coll, backbone, mode = self.coll, self.backbone, cfg.lookup_mode
+
+        @jax.jit
+        def eval_scores(state, seqs, cands):
+            embs = coll.lookup(state.tables, {"item": seqs}, mode=mode)
+            logits = backbone.apply(
+                {"params": state.dense_params}, embs["item"], key_padding_mask(seqs)
+            )
+            return score_candidates(logits, cands)
+
+        self._bert4rec_eval_scores = eval_scores
 
     # --------------------------------------------------------------- epochs
 
@@ -309,7 +406,13 @@ class Trainer:
                 profiled = False
             if n_steps >= next_log:
                 self.logger.log(epoch=epoch, step=n_steps, train_loss=float(loss))
-                next_log += cfg.log_every_n_steps
+                # chunked counting can jump n_steps past several intervals;
+                # advance past n_steps so each interval logs at most once
+                next_log = n_steps + cfg.log_every_n_steps
+        if profiled == "tracing":
+            # epoch ended inside the trace window: close it cleanly
+            jax.block_until_ready(loss_sum)
+            jax.profiler.stop_trace()
         dt = time.perf_counter() - t0
         avg = float(loss_sum) / n_steps if n_steps else 0.0
         self.logger.log(
@@ -379,19 +482,7 @@ class Trainer:
         return metrics
 
     def _evaluate_bert4rec(self, epoch: int) -> dict[str, float]:
-        from tdfo_tpu.models.bert4rec import key_padding_mask
-        from tdfo_tpu.train.seq import score_candidates
-
-        coll, backbone = self.coll, self.backbone
-
-        @jax.jit
-        def eval_scores(state, seqs, cands):
-            embs = coll.lookup(state.tables, {"item": seqs})
-            logits = backbone.apply(
-                {"params": state.dense_params}, embs["item"], key_padding_mask(seqs)
-            )
-            return score_candidates(logits, cands)
-
+        eval_scores = self._bert4rec_eval_scores
         acc: dict[str, float] = {}
         tot_w = 0.0
         rename = lambda raw: {"seqs": raw["eval_seqs"], "cands": raw["candidate_items"]}

@@ -161,12 +161,29 @@ def test_explicit_modes_reject_column_sharding(mesh8):
             coll.lookup(tables, ids, mode=mode)
 
 
-def test_table_wise_heterogeneous_group_rejected(mesh8):
-    with pytest.raises(ValueError, match="share dtype and init_scale"):
+def test_table_wise_group_per_table_init_scales(mesh8):
+    """Stacked table-wise groups honour each member's init scale (needed by
+    ctr_embedding_specs' per-table glorot bounds); dtype must still match."""
+    coll = ShardedEmbeddingCollection(
+        [
+            EmbeddingSpec("a", 32, 8, features=("a",), sharding="table", init_scale=1.0),
+            EmbeddingSpec("b", 32, 8, features=("b",), sharding="table", init_scale=0.01),
+        ],
+        mesh=mesh8,
+    )
+    tables = coll.init(jax.random.key(0))
+    ids = jnp.arange(32, dtype=jnp.int32)
+    out = coll.lookup(tables, {"a": ids, "b": ids})
+    a_max = float(jnp.abs(out["a"]).max())
+    b_max = float(jnp.abs(out["b"]).max())
+    assert 0.5 < a_max <= 1.0, a_max
+    assert 0.005 < b_max <= 0.01, b_max
+
+    with pytest.raises(ValueError, match="share a dtype"):
         ShardedEmbeddingCollection(
             [
-                EmbeddingSpec("a", 32, 8, sharding="table", init_scale=1.0),
-                EmbeddingSpec("b", 32, 8, sharding="table", init_scale=0.01),
+                EmbeddingSpec("a", 32, 8, sharding="table", dtype=jnp.float32),
+                EmbeddingSpec("b", 32, 8, sharding="table", dtype=jnp.bfloat16),
             ],
             mesh=mesh8,
         )
