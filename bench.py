@@ -201,7 +201,7 @@ def build_train_bench(batch_size: int, embed_dim: int):
     return run, make_args, b, floor_bytes, flops_per_example
 
 
-def build_sparse_train_bench(batch_size: int, embed_dim: int, use_pallas: bool = False):
+def build_sparse_train_bench(batch_size: int, embed_dim: int):
     """HEADLINE: the DMP regime — ShardedEmbeddingCollection + row-sparse
     in-backward Adam (``make_sparse_train_step``), the torchrec
     ``DistributedModelParallel`` + fused-optimizer equivalent.
@@ -240,8 +240,7 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int, use_pallas: bool =
         dense_params=dense,
         tx=optax.adamw(3e-4, weight_decay=1e-4),
         tables=tables,
-        sparse_opt=sparse_optimizer("adam", lr=3e-4, weight_decay=1e-4,
-                                    use_pallas=use_pallas),
+        sparse_opt=sparse_optimizer("adam", lr=3e-4, weight_decay=1e-4),
     )
     b = batch_size * mesh.shape["data"]
     inner = make_sparse_train_step(
@@ -416,8 +415,6 @@ def main() -> None:
     ap.add_argument("--dense", action="store_true",
                     help="bench the dense regime (nn.Embed + dense AdamW) "
                          "instead of the sparse/DMP headline")
-    ap.add_argument("--use-pallas", action="store_true",
-                    help="route the sparse update through the Pallas fused kernel")
     ap.add_argument("--skip-big-table", action="store_true")
     args = ap.parse_args()
 
@@ -429,7 +426,7 @@ def main() -> None:
         )
     else:
         run, make_args, global_batch, floor_bytes, flops_per_ex = (
-            build_sparse_train_bench(args.batch_size, args.embed_dim, args.use_pallas)
+            build_sparse_train_bench(args.batch_size, args.embed_dim)
         )
     sec_per_step = chain_time(run, make_args)
     if callable(floor_bytes):  # sparse floor depends on the generated batches

@@ -101,9 +101,11 @@ class Config:
     # attention core for sequence models: "full" (T x T), "ring"
     # (sequence-parallel over the seq mesh axis), "flash" (Pallas O(T) kernel)
     attn: str = "full"
-    # route sparse-Adam updates through the fused Pallas kernel
-    # (ops/pallas_kernels.sparse_adam_rows)
-    use_pallas: bool = False
+    # vocab size above which DMP-regime tables use fused fat-row storage
+    # (ops/pallas_kernels.fat_layout + the in-place DMA Adam kernel); smaller
+    # tables take the one-hot MXU update.  The kernel choice itself is
+    # automatic per backend — there is no "use pallas" switch to misconfigure.
+    fused_table_threshold: int = 16384
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---

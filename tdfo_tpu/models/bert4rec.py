@@ -153,6 +153,7 @@ def make_sharded_bert4rec(
     sharding: str = "row",
     dtype=jnp.float32,
     attn: str = "full",
+    fused_threshold: int | None = 16384,
 ):
     """The DMP-equivalent wiring (``torchrec/train.py:235-254``): item table in
     a ShardedEmbeddingCollection (sharded over ``model``), dense transformer
@@ -173,6 +174,11 @@ def make_sharded_bert4rec(
                 features=("item",),
                 sharding=sharding,
                 init_scale=1.0,  # torchrec weight_init_min/max = -1/1
+                # big item catalogues get fused fat-row storage (in-place
+                # DMA Adam, O(touched rows) updates)
+                fused=(fused_threshold is not None
+                       and sharding in ("row", "replicated")
+                       and cfg.vocab_size > fused_threshold),
             )
         ],
         mesh=mesh,

@@ -149,13 +149,19 @@ class TwoTowerBackbone(nn.Module):
 
 
 def ctr_embedding_specs(
-    size_map: Mapping[str, int], embed_dim: int, sharding: str = "row"
+    size_map: Mapping[str, int],
+    embed_dim: int,
+    sharding: str = "row",
+    fused_threshold: int | None = 16384,
 ):
     """Declare the 7 CTR tables for a ShardedEmbeddingCollection.
 
     Table ``{feat}_embed`` serves the corresponding input column; init is
     uniform with the glorot bound ``sqrt(6 / (V + D))`` so the DMP regime is
     init-equivalent to the dense regime's ``nn.Embed`` glorot tables.
+    Tables with more than ``fused_threshold`` rows use fused fat-row storage
+    (in-place DMA Adam; O(touched rows) updates at any scale); pass ``None``
+    to disable.
     """
     from tdfo_tpu.parallel.embedding import EmbeddingSpec
 
@@ -167,6 +173,9 @@ def ctr_embedding_specs(
             features=(_FEATURE_TO_INPUT[feat],),
             sharding=sharding,
             init_scale=math.sqrt(6.0 / (int(size_map[feat]) + embed_dim)),
+            fused=(fused_threshold is not None
+                   and sharding in ("row", "replicated")
+                   and int(size_map[feat]) > fused_threshold),
         )
         for feat in TWOTOWER_CATEGORICAL
     ]

@@ -12,12 +12,18 @@ those well.
     this kernel keeps each chip's block from materialising its local logits.
     Forward is a Pallas kernel; backward recomputes with the XLA formulation
     (a dedicated backward kernel is a further optimisation).
-  * :func:`sparse_adam_rows` — the fused in-backward embedding-optimizer
-    update (fbgemm ``EmbOptimType.ADAM`` parity, ``torchrec/train.py:191``):
-    one kernel pass fuses the three row gathers (table + both moments,
-    scalar-prefetch-driven index maps, the fbgemm TBE trick) with the Adam
-    math; a single XLA masked scatter lands the updates — no dense [V, D]
-    sweep anywhere.
+  * :func:`fat_adam_rows` — the fused in-backward embedding-optimizer update
+    (fbgemm ``EmbOptimType.ADAM`` parity, ``torchrec/train.py:191``) over the
+    framework's *fat row* storage layout ``[V, pad(3D, 128)]`` (table | mu |
+    nu interleaved per row, lane-padded).  The kernel streams the touched
+    rows HBM->VMEM with per-row async DMAs, applies the whole Adam math, and
+    DMA-writes the rows back IN PLACE (``input_output_aliases``) — measured
+    ~2x faster than even a single XLA scatter call on v5e, and it replaces a
+    gather + compute + 3 scatters.  The fat layout exists because Mosaic
+    requires DMA slices lane-aligned to 128: separate [V, 64] table/mu/nu
+    buffers cannot be row-DMA'd at all (a kernel attempting that fails to
+    compile on hardware), while one padded fat row is a single aligned
+    descriptor per row per direction.
 
 Both take ``interpret=`` for CPU-exact testing (the suite runs them in
 interpreter mode on the spoofed CPU mesh; the benchmark exercises the
@@ -33,7 +39,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention", "sparse_adam_rows"]
+__all__ = [
+    "flash_attention",
+    "fat_adam_rows",
+    "fat_layout",
+    "fat_components",
+    "fat_assemble",
+    "fat_pack",
+]
 
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
@@ -179,50 +192,146 @@ flash_attention.defvjp(
 
 
 # --------------------------------------------------------------------------
-# fused row-sparse adam
+# fused row-sparse adam over fat rows
 # --------------------------------------------------------------------------
 
+_LANE = 128  # Mosaic lane tile
+_SUB = 64  # component alignment: any 64-aligned interval of length <= 128
+#            starting at a 0/64 in-tile offset never straddles a lane tile
 
-def sparse_adam_rows(
-    table: jax.Array,  # [V, D]
-    mu: jax.Array,  # [V, D] f32
-    nu: jax.Array,  # [V, D] f32
-    uids: jax.Array,  # [U] unique row ids; sentinel = dtype max for padding
-    g: jax.Array,  # [U, D] deduped row gradients
+
+def fat_layout(d: int) -> tuple[int, int]:
+    """(component_stride, n_tiles) of the fat row layout for embedding dim d.
+
+    A fat row stores [table | mu | nu] as three components of ``stride``
+    lanes each (stride = d rounded up to 64, or to 128 when d > 64), shaped
+    ``[V, n_tiles, 128]``.  The 3D shape is load-bearing: Mosaic tiles the
+    trailing TWO dims, so per-row DMA (slicing dim 0 by 1) is always legal —
+    a 2D ``[V, 3d]`` layout is rejected for widths over one lane tile
+    (sublane misalignment), and separate [V, d] buffers cannot be row-DMA'd
+    at all for d < 128.  The 64-alignment guarantees each component lives in
+    whole-tile + half-tile pieces that static vector slices can reach.
+    """
+    stride = -(-d // _SUB) * _SUB
+    if d > _SUB:
+        stride = -(-d // _LANE) * _LANE
+    lanes = -(-3 * stride // _LANE) * _LANE
+    return stride, lanes // _LANE
+
+
+def fat_components(x: jax.Array, d: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """[..., T, 128] fat rows -> (table, mu, nu) views, each [..., d].
+    Pure jnp: used identically inside the Pallas kernel (on VMEM vectors,
+    d <= 128 — tile-local static slices only) and in the XLA fallback /
+    lookup paths (any d, via a flat reshape XLA folds away)."""
+    stride, _ = fat_layout(d)
+    if d > _LANE:  # XLA-only path: components span multiple tiles
+        flat = x.reshape(*x.shape[:-2], -1)
+        return tuple(flat[..., c * stride:c * stride + d] for c in range(3))
+    outs = []
+    for c in range(3):
+        o = c * stride
+        tile, off = o // _LANE, o % _LANE
+        # fat_layout guarantees off + d <= 128 here (no tile straddling)
+        outs.append(x[..., tile, off:off + d])
+    return tuple(outs)
+
+
+def fat_assemble(x: jax.Array, comps: tuple[jax.Array, ...], d: int) -> jax.Array:
+    """Write updated (table, mu, nu) back into fat rows, preserving padding
+    lanes from ``x``.  Returns the new [..., T, 128] array."""
+    stride, t_tiles = fat_layout(d)
+    if d > _LANE:  # XLA-only path (see fat_components)
+        flat = x.reshape(*x.shape[:-2], -1)
+        for c, comp in enumerate(comps):
+            flat = jax.lax.dynamic_update_slice_in_dim(
+                flat, comp, c * stride, axis=flat.ndim - 1
+            )
+        return flat.reshape(*x.shape)
+    tiles = []
+    for t in range(t_tiles):
+        segs = []
+        lane = 0
+        while lane < _LANE:
+            gl = t * _LANE + lane
+            c = gl // stride
+            if c < 3 and gl - c * stride < d:
+                off = gl - c * stride
+                take = min(d - off, _LANE - lane)
+                segs.append(comps[c][..., off:off + take])
+            else:
+                # padding lanes up to the next component start (or tile end)
+                nxt = min(
+                    [(cc * stride) for cc in range(3) if cc * stride > gl]
+                    + [(t + 1) * _LANE]
+                )
+                take = min(nxt, (t + 1) * _LANE) - gl
+                segs.append(x[..., t, lane:lane + take])
+            lane += take
+        tiles.append(jnp.concatenate(segs, axis=-1) if len(segs) > 1 else segs[0])
+    return jnp.stack(tiles, axis=-2)
+
+
+def fat_pack(table: jax.Array, mu: jax.Array, nu: jax.Array) -> jax.Array:
+    """[V, d] x3 -> [V, T, 128] fat rows (zero padding lanes)."""
+    v, d = table.shape
+    _, t_tiles = fat_layout(d)
+    zero = jnp.zeros((v, t_tiles, _LANE), jnp.float32)
+    return fat_assemble(
+        zero, (table.astype(jnp.float32), mu.astype(jnp.float32),
+               nu.astype(jnp.float32)), d
+    )
+
+
+def _adam_math(row, mu_r, nu_r, g_rows, corr, *, lr, b1, b2, eps, weight_decay):
+    mu_n = b1 * mu_r + (1 - b1) * g_rows
+    nu_n = b2 * nu_r + (1 - b2) * g_rows * g_rows
+    mu_hat = mu_n / corr[0]
+    nu_hat = nu_n / corr[1]
+    delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * row)
+    return row - delta, mu_n, nu_n
+
+
+def fat_adam_rows(
+    fat: jax.Array,  # [V, T, 128] f32 fat rows (fat_layout(d))
+    uids: jax.Array,  # [U] unique row ids; sentinel = int32 max for padding
+    g: jax.Array,  # [U, d] deduped row gradients
     step_count: jax.Array,  # scalar i32, 1-based after increment
     *,
+    d: int,
     lr: float,
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    rows_per_step: int = 128,
     interpret: bool = False,
 ):
-    """Fused Adam over the touched rows; returns (table, mu, nu).
+    """In-place fused lazy Adam on the touched rows of a fat table.
 
-    The kernel fuses the THREE row gathers (table, mu, nu — index maps driven
-    by the scalar-prefetched id vector, the fbgemm TBE trick) with the whole
-    Adam math, emitting compact [U, D] row updates; the final scatter is an
-    XLA ``.at[uids].set(mode="drop")`` on donated buffers, which drops the
-    padding sentinel natively.  One HBM read per touched row per buffer, one
-    scatter write — never a dense [V, D] pass.
+    Per grid step: ``rows_per_step`` row DMAs HBM->VMEM (all in flight
+    together, the fbgemm TBE structure), the full Adam math on the component
+    slices, and row DMAs straight back into the SAME buffer
+    (``input_output_aliases`` — the caller's array is donated).  Sentinel
+    rows read row 0 (harmless) and skip their write-back.  No XLA scatter
+    anywhere — measured ~3x faster on v5e than the gather + 3-scatter XLA
+    formulation it replaces; per-step HBM traffic is 2 x touched_rows x
+    row_bytes.
 
-    Writes are NOT index-mapped back into the tables from inside the kernel:
-    multiple grid steps may clamp to the same row (padding slots), and
-    aliased same-row read-modify-writes across grid steps race with block
-    pipelining.
+    Requires ``uids`` duplicate-free (``dedupe_grads``): duplicate real ids
+    would race on the same fat row across grid steps.  d must be <= 128
+    (larger dims use the XLA fallback in ``ops.sparse``).
     """
-    v_rows, d = table.shape
+    v_rows, t_tiles, lane = fat.shape
+    assert lane == _LANE and t_tiles == fat_layout(d)[1], (fat.shape, d)
+    assert d <= _LANE, "fat_adam_rows supports d <= 128; use the XLA fallback"
     u = uids.shape[0]
-    sentinel = jnp.iinfo(uids.dtype).max
-    rows_per_step = 8  # Mosaic tile height for f32
+    sentinel = jnp.iinfo(jnp.int32).max
+    rows_per_step = min(rows_per_step, -(-u // 8) * 8)
     u_pad = -(-u // rows_per_step) * rows_per_step
     pad = u_pad - u
-    uids_p = jnp.pad(uids, (0, pad), constant_values=sentinel)
+    uids_p = jnp.pad(uids.astype(jnp.int32), (0, pad), constant_values=sentinel)
     g_p = jnp.pad(g, ((0, pad), (0, 0)))
-    prefetch_ids = jnp.where(
-        uids_p == sentinel, 0, jnp.minimum(uids_p, v_rows - 1)
-    ).astype(jnp.int32)
     t_f = step_count.astype(jnp.float32)
     corr = jnp.stack([1.0 - b1**t_f, 1.0 - b2**t_f])
 
@@ -231,73 +340,64 @@ def sparse_adam_rows(
         grid=(u_pad // rows_per_step,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # [c1, c2] bias corrections
-            pl.BlockSpec((rows_per_step, d), lambda i, ids: (i, 0)),  # g rows
-            pl.BlockSpec(memory_space=pl.ANY),  # table (HBM, DMA'd)
-            pl.BlockSpec(memory_space=pl.ANY),  # mu
-            pl.BlockSpec(memory_space=pl.ANY),  # nu
+            pl.BlockSpec((rows_per_step, g.shape[1]), lambda i, ids: (i, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # fat (HBM, manual DMA)
         ],
-        out_specs=[
-            pl.BlockSpec((rows_per_step, d), lambda i, ids: (i, 0)),
-            pl.BlockSpec((rows_per_step, d), lambda i, ids: (i, 0)),
-            pl.BlockSpec((rows_per_step, d), lambda i, ids: (i, 0)),
-        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased with fat
         scratch_shapes=[
-            pltpu.VMEM((3, rows_per_step, d), jnp.float32),
-            pltpu.SemaphoreType.DMA((3, rows_per_step)),
+            pltpu.VMEM((rows_per_step, t_tiles, _LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA((rows_per_step,)),
         ],
     )
 
-    def kernel(ids_ref, corr_ref, g_ref, table_hbm, mu_hbm, nu_hbm,
-               out_row_ref, out_mu_ref, out_nu_ref, scratch, sems):
+    def kernel(ids_ref, corr_ref, g_ref, fat_hbm, out_hbm, scratch, sems):
         i = pl.program_id(0)
-        # gather this step's rows: 3 * rows_per_step small DMAs, all in flight
-        # together (the fbgemm TBE gather structure)
         for r in range(rows_per_step):
-            row_id = ids_ref[i * rows_per_step + r]
-            for b_idx, hbm in enumerate((table_hbm, mu_hbm, nu_hbm)):
+            rid = ids_ref[i * rows_per_step + r]
+            # sentinel rows read row 0: cheap, and their write is masked off
+            read = jnp.where(rid < v_rows, rid, 0)
+            pltpu.make_async_copy(
+                fat_hbm.at[pl.ds(read, 1)], scratch.at[pl.ds(r, 1)], sems.at[r]
+            ).start()
+        for r in range(rows_per_step):
+            rid = ids_ref[i * rows_per_step + r]
+            read = jnp.where(rid < v_rows, rid, 0)
+            pltpu.make_async_copy(
+                fat_hbm.at[pl.ds(read, 1)], scratch.at[pl.ds(r, 1)], sems.at[r]
+            ).wait()
+        x = scratch[...]  # [rows, T, 128]
+        row, mu_r, nu_r = fat_components(x, d)
+        g_rows = g_ref[...].astype(jnp.float32)
+        # bias corrections precomputed outside (Mosaic has no runtime powf)
+        new = _adam_math(row, mu_r, nu_r, g_rows, corr_ref, lr=lr, b1=b1,
+                         b2=b2, eps=eps, weight_decay=weight_decay)
+        scratch[...] = fat_assemble(x, new, d)
+        for r in range(rows_per_step):
+            rid = ids_ref[i * rows_per_step + r]
+
+            @pl.when(rid < v_rows)
+            def _():
                 pltpu.make_async_copy(
-                    hbm.at[pl.ds(row_id, 1), :],
-                    scratch.at[b_idx, pl.ds(r, 1), :],
-                    sems.at[b_idx, r],
+                    scratch.at[pl.ds(r, 1)], out_hbm.at[pl.ds(rid, 1)],
+                    sems.at[r],
                 ).start()
         for r in range(rows_per_step):
-            row_id = ids_ref[i * rows_per_step + r]
-            for b_idx, hbm in enumerate((table_hbm, mu_hbm, nu_hbm)):
-                pltpu.make_async_copy(
-                    hbm.at[pl.ds(row_id, 1), :],
-                    scratch.at[b_idx, pl.ds(r, 1), :],
-                    sems.at[b_idx, r],
-                ).wait()
-        g_rows = g_ref[:].astype(jnp.float32)
-        row = scratch[0]
-        mu_r = scratch[1]
-        nu_r = scratch[2]
-        mu_n = b1 * mu_r + (1 - b1) * g_rows
-        nu_n = b2 * nu_r + (1 - b2) * g_rows * g_rows
-        # Adam bias corrections precomputed outside (Mosaic has no runtime
-        # powf); corr_ref = [1 - b1^t, 1 - b2^t]
-        mu_hat = mu_n / corr_ref[0]
-        nu_hat = nu_n / corr_ref[1]
-        delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * row)
-        out_row_ref[:] = (row - delta).astype(out_row_ref.dtype)
-        out_mu_ref[:] = mu_n
-        out_nu_ref[:] = nu_n
+            rid = ids_ref[i * rows_per_step + r]
 
-    new_rows, new_mu, new_nu = pl.pallas_call(
+            @pl.when(rid < v_rows)
+            def _():
+                pltpu.make_async_copy(
+                    scratch.at[pl.ds(r, 1)], out_hbm.at[pl.ds(rid, 1)],
+                    sems.at[r],
+                ).wait()
+
+    return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((u_pad, d), table.dtype),
-            jax.ShapeDtypeStruct((u_pad, d), mu.dtype),
-            jax.ShapeDtypeStruct((u_pad, d), nu.dtype),
-        ],
+        out_shape=jax.ShapeDtypeStruct(fat.shape, fat.dtype),
+        input_output_aliases={3: 0},  # fat (operands: uids, corr, g, fat)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
         interpret=interpret,
-    )(prefetch_ids, corr, g_p, table, mu, nu)
-    new_rows, new_mu, new_nu = new_rows[:u], new_mu[:u], new_nu[:u]
-
-    # masked scatter: sentinel ids are out of bounds -> dropped
-    return (
-        table.at[uids].set(new_rows, mode="drop"),
-        mu.at[uids].set(new_mu, mode="drop"),
-        nu.at[uids].set(new_nu, mode="drop"),
-    )
+    )(uids_p, corr, g_p, fat)

@@ -32,6 +32,8 @@ __all__ = [
     "sparse_sgd",
     "sparse_adam",
     "sparse_adagrad",
+    "dense_lazy_adam",
+    "fat_adam_update",
     "SparseOptimizer",
     "sparse_optimizer",
 ]
@@ -142,13 +144,107 @@ def sparse_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10, weight_decay=
     )
 
 
+def dense_lazy_adam(table, mu, nu, count, ids, grads, *, lr, b1=0.9, b2=0.999,
+                    eps=1e-8, weight_decay=0.0):
+    """Small-vocab tier: lazy Adam via one-hot MXU matmuls + a dense masked
+    sweep.  Per-row gradient sums and touched-row counts come from a single
+    ``one_hot.T @ grads`` contraction (XLA fuses the one-hot generation into
+    the matmul — nothing [B, V]-sized is materialised), then table/mu/nu get
+    a full [V, D] read-modify-write.  For V up to ~16k this is dramatically
+    faster on TPU than any gather/scatter formulation (XLA scatter serialises
+    per row: ~1.4 ms for 8k rows on v5e vs ~100 us here), and there is no
+    sort, no dedupe, no scatter at all.  Negative (padding) ids one-hot to
+    zero rows, so they contribute nothing and count as untouched.
+
+    Semantics are identical to :func:`sparse_adam` (lazy moments: untouched
+    rows do not decay; decoupled weight decay on touched rows; global-step
+    bias correction).  Returns (table, mu, nu, count).
+    """
+    v = table.shape[0]
+    ids = ids.reshape(-1)
+    grads = grads.reshape(-1, grads.shape[-1]).astype(jnp.float32)
+    oh = jax.nn.one_hot(ids, v, dtype=jnp.float32)  # [B, V], fused into dots
+    gsum = jax.lax.dot_general(
+        oh, grads, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [V, D]
+    touched = (jnp.sum(oh, axis=0) > 0)[:, None]  # [V, 1]
+    new_count = count + 1
+    t = new_count.astype(jnp.float32)
+    mu_n = b1 * mu + (1 - b1) * gsum
+    nu_n = b2 * nu + (1 - b2) * gsum * gsum
+    mu_hat = mu_n / (1 - b1**t)
+    nu_hat = nu_n / (1 - b2**t)
+    delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps)
+                  + weight_decay * table.astype(jnp.float32))
+    return (
+        jnp.where(touched, table - delta.astype(table.dtype), table),
+        jnp.where(touched, mu_n, mu),
+        jnp.where(touched, nu_n, nu),
+        new_count,
+    )
+
+
+def fat_adam_update(fat, count, ids, grads, *, embedding_dim, lr, b1=0.9,
+                    b2=0.999, eps=1e-8, weight_decay=0.0,
+                    capacity: int | None = None):
+    """Big-table tier: fused lazy Adam over fat rows ``[V, T, 128]``
+    (``pallas_kernels.fat_layout``: table | mu | nu packed per row).
+
+    On TPU with d <= 128 this runs the in-place DMA kernel
+    (:func:`~tdfo_tpu.ops.pallas_kernels.fat_adam_rows`); elsewhere an XLA
+    formulation with ONE full-row gather and ONE full-row scatter — fat rows
+    exist precisely so the whole read-modify-write is a single descriptor
+    per row instead of 3 gathers + 3 scatters over separate table/mu/nu
+    buffers.  Returns (fat, count).
+    """
+    from tdfo_tpu.ops.pallas_kernels import (
+        fat_adam_rows,
+        fat_assemble,
+        fat_components,
+    )
+
+    d = embedding_dim
+    uids, g, valid = dedupe_grads(
+        ids.reshape(-1), grads.reshape(-1, grads.shape[-1]), capacity=capacity
+    )
+    new_count = count + 1
+    if jax.default_backend() == "tpu" and d <= 128:
+        fat = fat_adam_rows(
+            fat, uids, g, new_count, d=d, lr=lr, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay,
+        )
+        return fat, new_count
+    # XLA fallback (CPU tests, d > 128): numerically identical
+    rows = jnp.take(fat, jnp.minimum(uids, fat.shape[0] - 1), axis=0)  # [U, T, 128]
+    row, mu_r, nu_r = fat_components(rows, d)
+    t = new_count.astype(jnp.float32)
+    corr = jnp.stack([1.0 - b1**t, 1.0 - b2**t])
+    mu_n = b1 * mu_r + (1 - b1) * g.astype(jnp.float32)
+    nu_n = b2 * nu_r + (1 - b2) * g.astype(jnp.float32) ** 2
+    delta = lr * ((mu_n / corr[0]) / (jnp.sqrt(nu_n / corr[1]) + eps)
+                  + weight_decay * row)
+    new_rows = fat_assemble(rows, (row - delta, mu_n, nu_n), d)
+    # sentinel uids are out of bounds -> dropped by the scatter
+    return fat.at[uids].set(new_rows, mode="drop"), new_count
+
+
 @dataclass(frozen=True)
 class SparseOptimizer:
     """Uniform wrapper: init(table)->slots, update(table, slots, ids, grads)->(table, slots).
 
     The KeyedOptimizerWrapper/CombinedOptimizer equivalent for the sparse half
     (``torchrec/train.py:248-254``): dense params keep optax; each embedding
-    table gets one of these.
+    table gets one of these.  Adam dispatches across three tiers picked for
+    TPU cost structure (measured on v5e — XLA scatter serialises per row, so
+    scatter-free formulations win):
+
+      * fat storage (``table.ndim == 3``): in-place DMA kernel / single
+        row-granular gather+scatter — O(touched rows) traffic on tables of
+        any size (the >=1B-row path);
+      * plain storage, small vocab (<= ``small_vocab_threshold``): one-hot
+        MXU matmul + dense masked sweep, no sort/gather/scatter at all;
+      * plain storage, large vocab: dedupe + row gather/scatter (the
+        portable XLA formulation).
     """
 
     kind: str  # "sgd" | "adam" | "adagrad"
@@ -157,12 +253,13 @@ class SparseOptimizer:
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
-    # adam only: route through the Pallas fused gather kernel
-    # (tdfo_tpu/ops/pallas_kernels.sparse_adam_rows); falls back to interpret
-    # mode off-TPU so numerics are identical everywhere.
-    use_pallas: bool = False
+    small_vocab_threshold: int = 16384
 
     def init(self, table: jax.Array) -> Any:
+        if table.ndim == 3:  # fat rows carry their own moments
+            if self.kind != "adam":
+                raise ValueError("fat (fused) tables require the adam optimizer")
+            return (jnp.zeros((), jnp.int32),)
         if self.kind == "sgd":
             return ()
         if self.kind == "adagrad":
@@ -175,7 +272,25 @@ class SparseOptimizer:
             )
         raise ValueError(f"unknown sparse optimizer kind: {self.kind!r}")
 
-    def update(self, table, slots, ids, grads, *, capacity: int | None = None):
+    def update(self, table, slots, ids, grads, *, embedding_dim: int | None = None,
+               capacity: int | None = None):
+        if table.ndim == 3:
+            if embedding_dim is None:
+                raise ValueError("fat-table update needs embedding_dim")
+            (count,) = slots
+            table, count = fat_adam_update(
+                table, count, ids, grads, embedding_dim=embedding_dim,
+                lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay, capacity=capacity,
+            )
+            return table, (count,)
+        if self.kind == "adam" and table.shape[0] <= self.small_vocab_threshold:
+            mu, nu, count = slots
+            table, mu, nu, count = dense_lazy_adam(
+                table, mu, nu, count, ids, grads, lr=self.lr, b1=self.b1,
+                b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+            )
+            return table, (mu, nu, count)
         uids, g, valid = dedupe_grads(ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
                                       capacity=capacity)
         if self.kind == "sgd":
@@ -188,17 +303,6 @@ class SparseOptimizer:
             return table, (accum,)
         if self.kind == "adam":
             mu, nu, count = slots
-            if self.use_pallas:
-                from tdfo_tpu.ops.pallas_kernels import sparse_adam_rows
-
-                interp = jax.default_backend() != "tpu"
-                new_count = count + 1
-                table, mu, nu = sparse_adam_rows(
-                    table, mu, nu, uids, g, new_count, lr=self.lr, b1=self.b1,
-                    b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
-                    interpret=interp,
-                )
-                return table, (mu, nu, new_count)
             table, mu, nu, count = sparse_adam(
                 table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,

@@ -59,6 +59,12 @@ class EmbeddingSpec:
     # uniform(-init_scale, init_scale); torchrec weight_init_min/max = -1/1
     init_scale: float = 1.0
     dtype: jnp.dtype = jnp.float32
+    # fused in-backward Adam storage: the table lives as fat rows
+    # [V, T, 128] carrying [table | mu | nu] per row
+    # (ops/pallas_kernels.fat_layout) so the optimizer read-modify-writes one
+    # aligned DMA descriptor per touched row — the fbgemm-TBE-equivalent
+    # layout that makes O(batch) updates fast on TPU.  f32 only.
+    fused: bool = False
 
     def feature_names(self) -> tuple[str, ...]:
         return self.features or (self.name,)
@@ -91,6 +97,13 @@ class ShardedEmbeddingCollection:
         self.n_shards = mesh.shape[axis] if mesh is not None else 1
         self._feature_to_table: dict[str, str] = {}
         for s in specs:
+            if s.fused and s.sharding not in ("row", "replicated"):
+                raise ValueError(
+                    f"table {s.name!r}: fused storage supports row/replicated "
+                    f"sharding, not {s.sharding!r}"
+                )
+            if s.fused and s.dtype != jnp.float32:
+                raise ValueError(f"table {s.name!r}: fused storage is f32 only")
             for f in s.feature_names():
                 if f in self._feature_to_table:
                     raise ValueError(f"feature {f!r} served by two tables")
@@ -134,8 +147,9 @@ class ShardedEmbeddingCollection:
     def table_sharding(self, spec: EmbeddingSpec) -> NamedSharding | None:
         if self.mesh is None:
             return None
+        trailing = (None, None) if spec.fused else (None,)
         if spec.sharding == "row":
-            return NamedSharding(self.mesh, P(self.axis, None))
+            return NamedSharding(self.mesh, P(self.axis, *trailing))
         if spec.sharding == "column":
             return NamedSharding(self.mesh, P(None, self.axis))
         if spec.sharding == "replicated":
@@ -167,6 +181,11 @@ class ShardedEmbeddingCollection:
                 next(key_iter), (rows, dim), spec.dtype,
                 minval=-spec.init_scale, maxval=spec.init_scale,
             )
+            if spec.fused:
+                from tdfo_tpu.ops.pallas_kernels import fat_pack
+
+                z = jnp.zeros_like(t, dtype=jnp.float32)
+                t = fat_pack(t, z, z)  # [rows, T, 128]: moments start at zero
             sh = self.table_sharding(spec)
             tables[name] = jax.device_put(t, sh) if sh is not None else t
         for gname, group in self._groups.items():
@@ -213,6 +232,74 @@ class ShardedEmbeddingCollection:
     # backward-compat alias; prefer resolve()
     _resolve = resolve
 
+    def array_embedding_dim(self, array_name: str) -> int:
+        """Embedding dim of an ``init()`` pytree entry (stacked groups carry
+        it in their name; fat arrays don't expose it in their shape)."""
+        if array_name.startswith("__stack_"):
+            return int(array_name.removeprefix("__stack_"))
+        return self.specs[array_name].embedding_dim
+
+    def sparse_update(self, opt, array_name: str, table, slots, ids, grads):
+        """Apply the row-sparse optimizer to one table, sharding-aware.
+
+        For fused (fat-row) tables ROW-SHARDED over a real model axis the
+        update runs inside an explicit ``shard_map``: Pallas calls have no
+        GSPMD partitioning rule, so a plain jit would all-gather the whole
+        [V, T, 128] fat table onto every device — the opposite of the
+        O(touched-rows) property.  The program: all-gather (ids, grads) over
+        the data axis, mask to locally-owned rows, dedupe, in-place kernel on
+        the local shard.  Every data-axis replica computes its model shard's
+        update identically, so the result stays consistent and sharded.
+        Everything else routes straight to ``opt.update``.
+        """
+        d = self.array_embedding_dim(array_name)
+        spec = None
+        if not array_name.startswith("__stack_"):
+            spec = self.specs[array_name]
+        needs_shard_map = (
+            spec is not None and spec.fused and spec.sharding == "row"
+            and self.mesh is not None and self.n_shards > 1
+        )
+        if not needs_shard_map:
+            return opt.update(table, slots, ids, grads, embedding_dim=d)
+
+        from tdfo_tpu.core.mesh import DATA_AXIS
+        from tdfo_tpu.ops.sparse import fat_adam_update
+
+        axis = self.axis
+        (count,) = slots
+        rows_per_shard = table.shape[0] // self.n_shards
+        ids_flat = ids.reshape(-1)
+        grads_flat = grads.reshape(-1, grads.shape[-1])
+
+        def local(fat_shard, count, ids_local, grads_local):
+            ids_all = jax.lax.all_gather(ids_local, DATA_AXIS, tiled=True)
+            g_all = jax.lax.all_gather(grads_local, DATA_AXIS, tiled=True)
+            k = jax.lax.axis_index(axis)
+            local_ids = ids_all - k * rows_per_shard
+            mine = (local_ids >= 0) & (local_ids < rows_per_shard)
+            # foreign rows become negative -> dedupe maps them to the
+            # dropped sentinel; their (zeroed) grads contribute nothing
+            masked = jnp.where(mine, local_ids, -1)
+            g_masked = jnp.where(mine[:, None], g_all, 0.0)
+            new_fat, new_count = fat_adam_update(
+                fat_shard, count, masked, g_masked, embedding_dim=d,
+                lr=opt.lr, b1=opt.b1, b2=opt.b2, eps=opt.eps,
+                weight_decay=opt.weight_decay,
+            )
+            return new_fat, new_count
+
+        mesh = self.mesh
+        fat_spec = P(axis, None, None)
+        new_table, new_count = jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(fat_spec, P(), P(DATA_AXIS), P(DATA_AXIS, None)),
+            out_specs=(fat_spec, P()),
+            check_vma=False,
+        )(table, count, ids_flat, grads_flat)
+        return new_table, (new_count,)
+
     def lookup(
         self,
         tables: Mapping[str, jax.Array],
@@ -226,7 +313,15 @@ class ShardedEmbeddingCollection:
             tname, spec, offset = self.resolve(feat)
             table = tables[tname]
             if mode == "gspmd" or self.mesh is None or spec.sharding in ("replicated",):
+                # fused tables gather FULL fat rows then slice out the table
+                # component — a narrow (1, d)-slice gather from fat rows is
+                # pathologically slow on TPU (measured 100x+ worse), while
+                # the full-row gather matches a plain [V, d] gather.
                 vecs = jnp.take(table, ids + offset, axis=0)
+                if spec.fused:
+                    from tdfo_tpu.ops.pallas_kernels import fat_components
+
+                    vecs = fat_components(vecs, spec.embedding_dim)[0]
                 if self.mesh is not None and spec.sharding == "column":
                     vecs = jax.lax.with_sharding_constraint(
                         vecs, NamedSharding(self.mesh, P(*([None] * ids.ndim), self.axis))
@@ -240,15 +335,26 @@ class ShardedEmbeddingCollection:
                         f"but table {spec.name!r} is {spec.sharding!r}"
                     )
                 if mode == "psum":
-                    vecs = self._lookup_psum(table, ids + offset)
+                    vecs = self._lookup_psum(table, ids + offset, spec)
                 else:
-                    vecs = self._lookup_alltoall(table, ids + offset)
+                    vecs = self._lookup_alltoall(table, ids + offset, spec)
             else:
                 raise ValueError(f"unknown lookup mode {mode!r}")
             out[feat] = vecs
         return out
 
-    def _lookup_psum(self, table: jax.Array, ids: jax.Array) -> jax.Array:
+    def _extractor(self, spec: EmbeddingSpec):
+        """Row post-processing for explicit-collective programs: fused tables
+        yield fat rows whose table component must be sliced out BEFORE the
+        collective (also shrinks the bytes on the wire by 3-6x)."""
+        if not spec.fused:
+            return lambda rows: rows
+        from tdfo_tpu.ops.pallas_kernels import fat_components
+
+        return lambda rows: fat_components(rows, spec.embedding_dim)[0]
+
+    def _lookup_psum(self, table: jax.Array, ids: jax.Array,
+                     spec: EmbeddingSpec) -> jax.Array:
         """Explicit row-shard lookup: ids replicated over the model axis.
 
         Each device gathers rows it owns and zeros the rest; one ``psum``
@@ -258,13 +364,16 @@ class ShardedEmbeddingCollection:
         mesh = self.mesh
         axis = self.axis
         rows_per_shard = table.shape[0] // self.n_shards
+        extract = self._extractor(spec)
 
         def local(table_shard, ids_local):
             idx = jax.lax.axis_index(axis)
             start = idx * rows_per_shard
             local_ids = ids_local - start
             mine = (local_ids >= 0) & (local_ids < rows_per_shard)
-            gathered = jnp.take(table_shard, jnp.clip(local_ids, 0, rows_per_shard - 1), axis=0)
+            gathered = extract(jnp.take(
+                table_shard, jnp.clip(local_ids, 0, rows_per_shard - 1), axis=0
+            ))
             gathered = jnp.where(mine[..., None], gathered, 0)
             return jax.lax.psum(gathered, axis)
 
@@ -272,15 +381,17 @@ class ShardedEmbeddingCollection:
 
         ids_spec = P(DATA_AXIS, *([None] * (ids.ndim - 1)))
         out_spec = P(DATA_AXIS, *([None] * ids.ndim))
+        table_spec = P(axis, *([None] * (table.ndim - 1)))
         return jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(axis, None), ids_spec),
+            in_specs=(table_spec, ids_spec),
             out_specs=out_spec,
             check_vma=False,
         )(table, ids)
 
-    def _lookup_alltoall(self, table: jax.Array, ids: jax.Array) -> jax.Array:
+    def _lookup_alltoall(self, table: jax.Array, ids: jax.Array,
+                         spec: EmbeddingSpec) -> jax.Array:
         """torchrec input-dist/output-dist parity: batch AND table sharded
         over the same ``model`` axis.
 
@@ -292,13 +403,14 @@ class ShardedEmbeddingCollection:
         if ids.ndim != 1:
             orig_shape = ids.shape
             flat = ids.reshape(-1)
-            out = self._lookup_alltoall(table, flat)
+            out = self._lookup_alltoall(table, flat, spec)
             return out.reshape(*orig_shape, -1)
 
         mesh = self.mesh
         axis = self.axis
         m = self.n_shards
         rows_per_shard = table.shape[0] // m
+        extract = self._extractor(spec)
 
         def local(table_shard, ids_local):
             n = ids_local.shape[0]  # local batch
@@ -316,9 +428,9 @@ class ShardedEmbeddingCollection:
             recv_ids = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)  # [m, n]
             local_idx = recv_ids - jax.lax.axis_index(axis) * rows_per_shard
             valid = recv_ids >= 0
-            gathered = jnp.take(
+            gathered = extract(jnp.take(
                 table_shard, jnp.clip(local_idx, 0, rows_per_shard - 1), axis=0
-            )
+            ))
             gathered = jnp.where(valid[..., None], gathered, 0)
             # send vectors back to requesters
             back = jax.lax.all_to_all(gathered, axis, split_axis=0, concat_axis=0)  # [m, n, D]
@@ -329,10 +441,11 @@ class ShardedEmbeddingCollection:
             inv = jnp.argsort(order, stable=True)
             return jnp.take(answers_sorted, inv, axis=0)
 
+        table_spec = P(axis, *([None] * (table.ndim - 1)))
         return jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(P(axis, None), P(axis)),
+            in_specs=(table_spec, P(axis)),
             out_specs=P(axis),
             check_vma=False,
         )(table, ids)

@@ -119,8 +119,11 @@ def make_sparse_train_step(
                 grad_list.append(g_embs[f].reshape(-1, g_embs[f].shape[-1]))
             all_ids = jnp.concatenate(id_list)
             all_grads = jnp.concatenate(grad_list)
-            new_tables[tname], new_slots[tname] = state.sparse_opt.update(
-                state.tables[tname], state.slots[tname], all_ids, all_grads
+            # sharding-aware routing: fused row-sharded tables update inside
+            # an explicit shard_map (Pallas has no GSPMD partition rule)
+            new_tables[tname], new_slots[tname] = coll.sparse_update(
+                state.sparse_opt, tname,
+                state.tables[tname], state.slots[tname], all_ids, all_grads,
             )
 
         return (

@@ -93,6 +93,53 @@ def pad_batch(batch: dict[str, np.ndarray], size: int) -> tuple[dict[str, np.nda
     return out, w
 
 
+def _ctr_eval_schema() -> dict[str, tuple]:
+    """Post-rename eval-batch schema for the CTR family: key ->
+    (numpy dtype, trailing shape).  The authority for (a) restricting real
+    batches so every host ships an identical pytree and (b) synthesising
+    zero-weight template batches on hosts with no eval rows — dtypes match
+    what the CTR preprocessing writes to parquet."""
+    from tdfo_tpu.models.twotower import (
+        TWOTOWER_CATEGORICAL,
+        TWOTOWER_CONTINUOUS,
+        _FEATURE_TO_INPUT,
+    )
+
+    schema: dict[str, tuple] = {
+        _FEATURE_TO_INPUT[f]: (np.int32, ()) for f in TWOTOWER_CATEGORICAL
+    }
+    for c in TWOTOWER_CONTINUOUS:
+        schema[c] = (np.float32, ())
+    schema["label"] = (np.int8, ())
+    return schema
+
+
+def _make_ctr_eval_accum(logits_fn: Callable):
+    """Device-side eval accumulator for the CTR family.
+
+    One jitted call per batch folds (weighted loss sum, weight sum, streaming
+    AUC histograms) into a replicated accumulator pytree — the host only
+    fetches floats ONCE at epoch end.  Under a multi-host mesh the reductions
+    are global (GSPMD inserts the cross-host psums), replacing torchrec's
+    ``all_gather_object`` metric aggregation (``torchrec/train.py:108-111``)
+    and never touching non-addressable shards from the host.
+    """
+
+    @jax.jit
+    def accum(state, batch, acc):
+        w = batch["_weight"]
+        logits = logits_fn(state, batch)
+        labels = batch["label"].astype(jnp.float32)
+        loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
+        return {
+            "loss_sum": acc["loss_sum"] + (loss_vec * w).sum(),
+            "w_sum": acc["w_sum"] + w.sum(),
+            "auc": acc["auc"].update(labels, jax.nn.sigmoid(logits), w),
+        }
+
+    return accum
+
+
 class Trainer:
     """Config-driven trainer for both workload families."""
 
@@ -177,6 +224,10 @@ class Trainer:
         else:
             self.train_step = make_train_step(mesh=self.mesh)
         self.eval_step = make_eval_step(mesh=self.mesh)
+        self._eval_schema = _ctr_eval_schema()
+        self.eval_accum = _make_ctr_eval_accum(
+            lambda state, batch: state.apply_fn({"params": state.params}, batch)
+        )
 
     def _build_ctr_sparse(self) -> None:
         import optax as _optax
@@ -205,7 +256,8 @@ class Trainer:
         dtype = compute_dtype(cfg.mixed_precision)
         sharding = cfg.embedding_sharding if cfg.model_parallel else "replicated"
         coll = ShardedEmbeddingCollection(
-            ctr_embedding_specs(cfg.size_map, cfg.embed_dim, sharding),
+            ctr_embedding_specs(cfg.size_map, cfg.embed_dim, sharding,
+                                fused_threshold=cfg.fused_table_threshold),
             mesh=self.mesh,
         )
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
@@ -226,9 +278,12 @@ class Trainer:
             dense_params=dense,
             tx=_optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
             tables=tables,
+            # small_vocab_threshold stays at its own default: the one-hot
+            # tier's viability is a fixed TPU property, while
+            # fused_table_threshold is a storage-layout choice — one knob
+            # must not drag the other
             sparse_opt=sparse_optimizer(
                 "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
-                use_pallas=cfg.use_pallas,
             ),
         )
         if cfg.steps_per_execution > 1:
@@ -245,6 +300,14 @@ class Trainer:
                 mode=cfg.lookup_mode, donate=False,
             )
         self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
+        self._eval_schema = _ctr_eval_schema()
+        features, mode = list(coll.features()), cfg.lookup_mode
+
+        def sparse_logits(state, batch):
+            embs = coll.lookup(state.tables, {f: batch[f] for f in features}, mode=mode)
+            return backbone.apply({"params": state.dense_params}, embs, batch)
+
+        self.eval_accum = _make_ctr_eval_accum(sparse_logits)
 
     def _build_bert4rec(self) -> None:
         from tdfo_tpu.models.bert4rec import Bert4RecConfig, make_sharded_bert4rec
@@ -268,14 +331,18 @@ class Trainer:
         self.coll, tables, self.backbone, dense = make_sharded_bert4rec(
             jax.random.key(cfg.seed), self.model_cfg, self.mesh,
             sharding=sharding, attn=cfg.attn,
+            fused_threshold=cfg.fused_table_threshold,
         )
         self.state = SparseTrainState.create(
             dense_params=dense,
             tx=optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
             tables=tables,
+            # small_vocab_threshold stays at its own default: the one-hot
+            # tier's viability is a fixed TPU property, while
+            # fused_table_threshold is a storage-layout choice — one knob
+            # must not drag the other
             sparse_opt=sparse_optimizer(
                 "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
-                use_pallas=cfg.use_pallas,
             ),
         )
         if cfg.steps_per_execution > 1:
@@ -296,22 +363,37 @@ class Trainer:
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
         self._eval_pattern = str(Path("parquet_bert4rec") / cfg.eval_data)
 
-        # eval scorer built ONCE (a fresh jit closure per eval epoch would
-        # recompile every time) and honouring the configured lookup program
+        # eval accumulator built ONCE (a fresh jit closure per eval epoch
+        # would recompile every time), honouring the configured lookup
+        # program, and folding metrics on device — multihost-global by
+        # construction (see _make_ctr_eval_accum's docstring).
+        from tdfo_tpu.data.seq_preprocessing import EVAL_NEG_NUM
         from tdfo_tpu.models.bert4rec import key_padding_mask
         from tdfo_tpu.train.seq import score_candidates
 
+        self._eval_schema = {
+            "seqs": (np.int32, (cfg.max_len,)),
+            "cands": (np.int32, (EVAL_NEG_NUM + 1,)),
+        }
         coll, backbone, mode = self.coll, self.backbone, cfg.lookup_mode
 
         @jax.jit
-        def eval_scores(state, seqs, cands):
-            embs = coll.lookup(state.tables, {"item": seqs}, mode=mode)
+        def eval_accum(state, batch, acc):
+            w = batch["_weight"]
+            embs = coll.lookup(state.tables, {"item": batch["seqs"]}, mode=mode)
             logits = backbone.apply(
-                {"params": state.dense_params}, embs["item"], key_padding_mask(seqs)
+                {"params": state.dense_params}, embs["item"],
+                key_padding_mask(batch["seqs"]),
             )
-            return score_candidates(logits, cands)
+            scores = score_candidates(logits, batch["cands"])
+            labels = jnp.zeros_like(scores).at[:, 0].set(1.0)
+            m = recalls_and_ndcgs_for_ks(scores, labels, row_weights=w)
+            out = {"w_sum": acc["w_sum"] + w.sum()}
+            for k, v in m.items():
+                out[k] = acc[k] + v * w.sum()
+            return out
 
-        self._bert4rec_eval_scores = eval_scores
+        self.eval_accum = eval_accum
 
     # --------------------------------------------------------------- epochs
 
@@ -433,69 +515,80 @@ class Trainer:
         """Padded, budgeted, mesh-sharded eval batches.
 
         Every host yields exactly ``max_batches_per_host()`` batches — short
-        hosts top up with zero-weight padding batches — so the jitted eval
-        computation (a global-mesh program) runs in lockstep and never
-        deadlocks (the drop_last=False twin of the train-loop invariant).
-        Each batch carries a ``_weight`` row mask.
+        hosts (including hosts with NO eval rows at all) top up with
+        zero-weight template batches synthesised from ``self._eval_schema``
+        — so the jitted eval computation (a global-mesh program) runs in
+        lockstep and never deadlocks (the drop_last=False twin of the
+        train-loop invariant).  Real batches are restricted to the schema's
+        keys so every host ships an identical pytree regardless of which
+        extra columns its files carry.  Each batch has a ``_weight`` row
+        mask.
         """
         stream = self._stream(self._eval_pattern, train=False)
         budget = stream.max_batches_per_host()
         bsz = stream.batch_size
+        schema = self._eval_schema
+
+        def template() -> dict[str, np.ndarray]:
+            t = {k: np.zeros((bsz, *shape), dtype) for k, (dtype, shape) in schema.items()}
+            t["_weight"] = np.zeros((bsz,), np.float32)
+            return t
 
         def gen():
-            template = None
             n = 0
             for raw in stream:
                 if rename is not None:
                     raw = rename(raw)
-                batch, w = pad_batch(raw, bsz)
+                # cast to the schema dtypes: loaders differ (tfrecord decodes
+                # ints as int64, parquet as int32/int8) and real batches must
+                # be aval-identical to synthesized templates on EVERY host
+                real = {
+                    k: np.asarray(raw[k]).astype(dtype, copy=False)
+                    for k, (dtype, _) in schema.items()
+                }
+                batch, w = pad_batch(real, bsz)
                 batch = dict(batch, _weight=w)
-                template = batch
                 n += 1
                 yield batch
-            if n < budget and template is None:
-                raise RuntimeError(
-                    "host has no eval rows at all; cannot synthesise padding "
-                    "batches (give every host at least one eval shard)"
-                )
             while n < budget:
-                yield {k: np.zeros_like(v) for k, v in template.items()}
+                yield template()
                 n += 1
 
         yield from prefetch_to_mesh(gen(), self.mesh, P("data"))
 
     def _evaluate_twotower(self, epoch: int) -> dict[str, float]:
-        auc = AUC.empty()
-        tot_loss, tot_w = 0.0, 0.0
+        """Eval metrics accumulate ON DEVICE as a replicated pytree; the host
+        fetches floats once at the end.  Every reduction is global across the
+        whole mesh (multi-host included), so this is the ``all_gather_object``
+        capability (``torchrec/train.py:108-111``) with zero host collectives
+        — and no per-batch ``float()`` sync stalling the eval pipeline."""
+        acc = {
+            "loss_sum": jnp.zeros(()),
+            "w_sum": jnp.zeros(()),
+            "auc": AUC.empty(),
+        }
         for batch in self._eval_batches():
-            w = batch.pop("_weight")
-            _, logits = self.eval_step(self.state, batch)
-            # weighted loss: padding rows must not bias the mean
-            loss_vec = optax.sigmoid_binary_cross_entropy(
-                logits, batch["label"].astype(jnp.float32)
-            )
-            tot_loss += float((loss_vec * w).sum())
-            tot_w += float(w.sum())
-            auc = auc.update(batch["label"], jax.nn.sigmoid(logits), w)
-        metrics = {"eval_loss": tot_loss / max(tot_w, 1.0), "auc": float(auc.result())}
+            acc = self.eval_accum(self.state, batch, acc)
+        w = max(float(acc["w_sum"]), 1.0)
+        metrics = {
+            "eval_loss": float(acc["loss_sum"]) / w,
+            "auc": float(acc["auc"].result()),
+        }
         self.logger.log(epoch=epoch, **metrics)
         return metrics
 
+    _METRIC_KS = (10, 20, 50)
+
     def _evaluate_bert4rec(self, epoch: int) -> dict[str, float]:
-        eval_scores = self._bert4rec_eval_scores
-        acc: dict[str, float] = {}
-        tot_w = 0.0
+        acc: dict[str, jax.Array] = {"w_sum": jnp.zeros(())}
+        for k in self._METRIC_KS:
+            acc[f"Recall@{k}"] = jnp.zeros(())
+            acc[f"NDCG@{k}"] = jnp.zeros(())
         rename = lambda raw: {"seqs": raw["eval_seqs"], "cands": raw["candidate_items"]}
         for batch in self._eval_batches(rename):
-            w = batch["_weight"]
-            scores = eval_scores(self.state, batch["seqs"], batch["cands"])
-            labels = jnp.zeros_like(scores).at[:, 0].set(1.0)
-            m = recalls_and_ndcgs_for_ks(scores, labels, row_weights=w)
-            n = float(w.sum())
-            for k, v in m.items():
-                acc[k] = acc.get(k, 0.0) + float(v) * n
-            tot_w += n
-        metrics = {k: v / max(tot_w, 1.0) for k, v in acc.items()}
+            acc = self.eval_accum(self.state, batch, acc)
+        w = max(float(acc.pop("w_sum")), 1.0)
+        metrics = {k: float(v) / w for k, v in acc.items()}
         self.logger.log(epoch=epoch, **metrics)
         return metrics
 

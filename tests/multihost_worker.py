@@ -1,0 +1,69 @@
+"""Worker for the 2-process multihost test (run as a subprocess, NOT pytest).
+
+Usage:
+    python multihost_worker.py <process_id> <num_processes> <coordinator_port>
+                               <local_devices> <data_dir> <out_json> [model]
+
+Each process spoofs ``local_devices`` CPU devices, joins the jax distributed
+cluster, trains/evaluates through the SAME Trainer as single-host runs, and
+writes its view of the (global) metrics to ``out_json``.  The pytest driver
+asserts that every process reports identical, provably-global numbers.
+"""
+
+import json
+import sys
+
+
+def main() -> None:
+    pid, nprocs, port, ndev = (int(a) for a in sys.argv[1:5])
+    data_dir, out_json = sys.argv[5], sys.argv[6]
+    model = sys.argv[7] if len(sys.argv) > 7 else "twotower"
+
+    from tdfo_tpu.core.mesh import spoof_cpu_devices
+
+    spoof_cpu_devices(ndev)
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert jax.process_count() == nprocs
+    assert jax.local_device_count() == ndev
+
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.train.trainer import Trainer
+
+    cfg = read_configs(
+        None,
+        data_dir=data_dir,
+        model=model,
+        n_epochs=1,
+        learning_rate=3e-3,
+        embed_dim=8,
+        per_device_train_batch_size=8,
+        per_device_eval_batch_size=8,
+        shuffle_buffer_size=500,
+        log_every_n_steps=10_000,
+        size_map=load_size_map(data_dir),
+        mesh={"data": nprocs * ndev},
+    )
+    tr = Trainer(cfg)
+    pre = tr.evaluate(epoch=-1)  # deterministic init -> must be global-identical
+    tr.train_epoch(0)
+    post = tr.evaluate(epoch=0)
+    record = {
+        "process": pid,
+        "pre": pre,
+        "post": post,
+        "steps": int(tr.state.step),
+    }
+    with open(out_json, "w") as f:
+        json.dump(record, f)
+    print(f"worker {pid} done: {record}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,5 +94,5 @@ def test_new_knob_validation():
         with _pytest.raises(ValueError):
             Config(**bad)
     # valid combinations construct fine
-    Config(lookup_mode="alltoall", attn="ring", use_pallas=True,
+    Config(lookup_mode="alltoall", attn="ring", fused_table_threshold=8,
            steps_per_execution=4, streaming=False)

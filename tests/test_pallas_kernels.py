@@ -9,7 +9,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tdfo_tpu.ops.pallas_kernels import flash_attention, sparse_adam_rows
+from tdfo_tpu.ops.pallas_kernels import (
+    fat_adam_rows,
+    fat_components,
+    fat_layout,
+    fat_pack,
+    flash_attention,
+)
 from tdfo_tpu.ops.sparse import dedupe_grads, sparse_adam
 
 
@@ -75,8 +81,24 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-5)
 
 
-class TestSparseAdamRows:
-    def _setup(self, v=64, d=128, b=32, seed=0):
+class TestFatLayout:
+    @pytest.mark.parametrize("d", [16, 42, 64, 96, 128, 200])
+    def test_pack_components_roundtrip(self, d):
+        rng = np.random.default_rng(d)
+        v = 24
+        t, mu, nu = (jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+                     for _ in range(3))
+        fat = fat_pack(t, mu, nu)
+        stride, tiles = fat_layout(d)
+        assert fat.shape == (v, tiles, 128)
+        assert stride >= d and stride % 64 == 0
+        got = fat_components(fat, d)
+        for a, b in zip(got, (t, mu, nu)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestFatAdamRows:
+    def _setup(self, v=64, d=64, b=32, seed=0):
         rng = np.random.default_rng(seed)
         table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
         mu = jnp.zeros((v, d), jnp.float32)
@@ -85,17 +107,22 @@ class TestSparseAdamRows:
         grads = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
         return table, mu, nu, ids, grads
 
-    def test_matches_xla_sparse_adam(self):
-        table, mu, nu, ids, grads = self._setup()
+    @pytest.mark.parametrize("d", [16, 64, 128])
+    def test_matches_xla_sparse_adam(self, d):
+        """The in-place DMA kernel (interpret mode) must reproduce the plain
+        three-buffer XLA lazy Adam exactly."""
+        table, mu, nu, ids, grads = self._setup(d=d)
         uids, g, valid = dedupe_grads(ids, grads)
         count = jnp.asarray(0, jnp.int32)
         t_ref, mu_ref, nu_ref, _ = sparse_adam(
             table, mu, nu, count, uids, g, valid, lr=1e-2, weight_decay=0.01
         )
-        t_pl, mu_pl, nu_pl = sparse_adam_rows(
-            table, mu, nu, uids, g, count + 1, lr=1e-2, weight_decay=0.01,
+        fat = fat_pack(table, mu, nu)
+        fat_new = fat_adam_rows(
+            fat, uids, g, count + 1, d=d, lr=1e-2, weight_decay=0.01,
             interpret=True,
         )
+        t_pl, mu_pl, nu_pl = fat_components(fat_new, d)
         np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(mu_pl), np.asarray(mu_ref), rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(nu_pl), np.asarray(nu_ref), rtol=1e-5, atol=1e-6)
@@ -103,42 +130,73 @@ class TestSparseAdamRows:
     def test_untouched_rows_unchanged(self):
         table, mu, nu, ids, grads = self._setup()
         uids, g, valid = dedupe_grads(ids, grads)
-        t_pl, _, _ = sparse_adam_rows(
-            table, mu, nu, uids, g, jnp.asarray(1, jnp.int32), lr=1e-2, interpret=True
+        fat = fat_pack(table, mu, nu)
+        fat_new = fat_adam_rows(
+            fat, uids, g, jnp.asarray(1, jnp.int32), d=table.shape[1], lr=1e-2,
+            interpret=True,
         )
         touched = set(np.asarray(uids[np.asarray(valid)]).tolist())
         for r in range(table.shape[0]):
             if r not in touched:
-                np.testing.assert_array_equal(np.asarray(t_pl[r]), np.asarray(table[r]))
+                np.testing.assert_array_equal(
+                    np.asarray(fat_new[r]), np.asarray(fat[r])
+                )
 
     def test_padding_slots_are_noops(self):
         table, mu, nu, _, _ = self._setup(b=8)
+        d = table.shape[1]
         sent = jnp.iinfo(jnp.int32).max
-        uids = jnp.array([3, 7, sent, sent, sent, sent, sent, sent], jnp.int32)
-        g = jnp.ones((8, table.shape[1]), jnp.float32)
+        uids = jnp.array([3, 7] + [sent] * 6, jnp.int32)
+        g = jnp.ones((8, d), jnp.float32)
         g = g.at[2:].set(999.0)  # garbage grads on padding slots must not land
-        t_pl, mu_pl, _ = sparse_adam_rows(
-            table, mu, nu, uids, g, jnp.asarray(1, jnp.int32), lr=1e-2, interpret=True
+        fat = fat_pack(table, mu, nu)
+        fat_new = fat_adam_rows(
+            fat, uids, g, jnp.asarray(1, jnp.int32), d=d, lr=1e-2, interpret=True
         )
+        t_pl = fat_components(fat_new, d)[0]
         assert not np.array_equal(np.asarray(t_pl[3]), np.asarray(table[3]))
         assert not np.array_equal(np.asarray(t_pl[7]), np.asarray(table[7]))
         np.testing.assert_array_equal(np.asarray(t_pl[0]), np.asarray(table[0]))
 
 
-def test_sparse_optimizer_pallas_path_matches_xla():
-    from tdfo_tpu.ops.sparse import sparse_optimizer
+class TestSparseOptimizerTiers:
+    """The three adam tiers (one-hot small-vocab, fat fused, plain) are one
+    optimizer semantically: identical trajectories on identical data."""
 
-    rng = np.random.default_rng(3)
-    table = jnp.asarray(rng.normal(size=(50, 128)).astype(np.float32))
-    ids = jnp.asarray(rng.integers(0, 50, 16).astype(np.int32))
-    grads = jnp.asarray(rng.normal(size=(16, 128)).astype(np.float32))
-    ref_opt = sparse_optimizer("adam", lr=1e-2, weight_decay=0.01)
-    pl_opt = sparse_optimizer("adam", lr=1e-2, weight_decay=0.01, use_pallas=True)
-    t_ref, s_ref = ref_opt.update(table, ref_opt.init(table), ids, grads)
-    t_pl, s_pl = pl_opt.update(table, pl_opt.init(table), ids, grads)
-    np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
-    np.testing.assert_allclose(np.asarray(s_pl[0]), np.asarray(s_ref[0]), rtol=1e-5, atol=1e-6)
-    assert int(s_pl[2]) == int(s_ref[2]) == 1
+    def _data(self, v, d, b=24, seed=3):
+        rng = np.random.default_rng(seed)
+        table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(0, v, b).astype(np.int32))
+        grads = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
+        return table, ids, grads
+
+    def test_onehot_tier_matches_plain(self):
+        from tdfo_tpu.ops.sparse import sparse_optimizer
+
+        table, ids, grads = self._data(v=50, d=32)
+        small = sparse_optimizer("adam", lr=1e-2, weight_decay=0.01)  # v<=thresh
+        plain = sparse_optimizer("adam", lr=1e-2, weight_decay=0.01,
+                                 small_vocab_threshold=0)
+        t_a, s_a = small.update(table, small.init(table), ids, grads)
+        t_b, s_b = plain.update(table, plain.init(table), ids, grads)
+        np.testing.assert_allclose(np.asarray(t_a), np.asarray(t_b), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(s_a[0]), np.asarray(s_b[0]), rtol=1e-5, atol=1e-6)
+        assert int(s_a[2]) == int(s_b[2]) == 1
+
+    @pytest.mark.parametrize("d", [64, 200])
+    def test_fat_tier_matches_plain(self, d):
+        from tdfo_tpu.ops.sparse import sparse_optimizer
+
+        table, ids, grads = self._data(v=64, d=d)
+        opt = sparse_optimizer("adam", lr=1e-2, weight_decay=0.01,
+                               small_vocab_threshold=0)
+        t_ref, _ = opt.update(table, opt.init(table), ids, grads)
+        fat = fat_pack(table, jnp.zeros_like(table), jnp.zeros_like(table))
+        fat_new, slots = opt.update(fat, opt.init(fat), ids, grads,
+                                    embedding_dim=d)
+        t_fat = fat_components(fat_new, d)[0]
+        np.testing.assert_allclose(np.asarray(t_fat), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
+        assert int(slots[0]) == 1
 
 
 def test_bert4rec_flash_attn_matches_full(mesh8):

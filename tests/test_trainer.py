@@ -169,9 +169,10 @@ def test_twotower_map_style_loader(prepared_dir, tmp_path):
 
 
 def test_bert4rec_config_wired_islands(prepared_dir, tmp_path):
-    """attn/lookup_mode/use_pallas/steps_per_execution are reachable from
-    Config: flash attention (interpret on CPU), psum lookup program over a
-    2-shard model axis, Pallas sparse Adam, 2-step compiled loop."""
+    """attn/lookup_mode/fused_table_threshold/steps_per_execution are
+    reachable from Config: flash attention (interpret on CPU), psum lookup
+    program over a 2-shard model axis, fused fat-row sparse Adam (threshold
+    forced low so the item table takes the fat tier), 2-step compiled loop."""
     d, _, seq = prepared_dir
     cfg = read_configs(
         None,
@@ -180,7 +181,7 @@ def test_bert4rec_config_wired_islands(prepared_dir, tmp_path):
         model_parallel=True,
         attn="flash",
         lookup_mode="psum",
-        use_pallas=True,
+        fused_table_threshold=8,
         steps_per_execution=2,
         mesh={"data": 4, "model": 2},
         n_epochs=1,
