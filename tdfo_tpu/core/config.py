@@ -90,6 +90,11 @@ class Config:
     sliding_step: int = 10
     mask_prob: float = 0.2
     dropout: float = 0.1
+    # runtime variable-length sequences (torchrec KJT parity): preprocessing
+    # writes RAGGED windows (preprocess-seq pads nothing), the loader ships
+    # (values, lengths) pairs, and jagged_to_dense runs inside the jitted
+    # step.  bert4rec only.
+    jagged: bool = False
 
     # --- parallelism (L3) ---
     model_parallel: bool = False
@@ -98,6 +103,11 @@ class Config:
     # schedules the collectives), "psum" (explicit shard_map, one psum), or
     # "alltoall" (torchrec input-dist/output-dist parity, 2 collectives)
     lookup_mode: str = "gspmd"
+    # alltoall send-bucket capacity as a multiple of the balanced share
+    # (local_batch / n_shards); 0 = exact worst case (capacity = local
+    # batch).  Finite factors shrink the a2a payload ~n_shards/factor but
+    # drop ids past a bucket's capacity (zero vectors) under extreme skew.
+    a2a_capacity_factor: float = 0.0
     # attention core for sequence models: "full" (T x T), "ring"
     # (sequence-parallel over the seq mesh axis), "flash" (Pallas O(T) kernel)
     attn: str = "full"
@@ -136,6 +146,10 @@ class Config:
             raise ValueError(f"unknown embedding_sharding: {self.embedding_sharding!r}")
         if self.lookup_mode not in ("gspmd", "psum", "alltoall"):
             raise ValueError(f"unknown lookup_mode: {self.lookup_mode!r}")
+        if self.a2a_capacity_factor < 0:
+            raise ValueError("a2a_capacity_factor must be >= 0 (0 = exact)")
+        if self.jagged and self.model != "bert4rec":
+            raise ValueError("jagged=true is a sequence-model knob (bert4rec)")
         if self.attn not in ("full", "ring", "flash"):
             raise ValueError(f"unknown attn: {self.attn!r}")
         if self.steps_per_execution < 1:

@@ -30,8 +30,10 @@ __all__ = [
     "JaggedTensor",
     "KeyedJagged",
     "jagged_to_dense",
+    "jagged_to_dense_per_host",
     "dense_to_jagged",
     "lengths_to_offsets",
+    "pack_rows",
 ]
 
 
@@ -106,6 +108,50 @@ def jagged_to_dense(values: jax.Array, lengths: jax.Array, max_len: int, pad_val
     valid = pos < lengths[:, None]  # [B, T]
     gather_idx = jnp.where(valid, gather_idx, 0)
     dense = jnp.take(values, gather_idx, axis=0)  # [B, T, ...]
+    mask = valid if dense.ndim == 2 else valid[..., None]
+    return jnp.where(mask, dense, jnp.asarray(pad_value, dense.dtype))
+
+
+def pack_rows(rows, capacity: int, dtype=np.int32) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: a sequence of variable-length rows -> (values[capacity],
+    lengths[B]) numpy arrays, zero-padded tail.  The loader's ragged object
+    columns feed straight in; the device side reads them back with
+    :func:`jagged_to_dense` inside the jitted step."""
+    lengths = np.fromiter((len(r) for r in rows), np.int32, len(rows))
+    n = int(lengths.sum())
+    if capacity < n:
+        raise ValueError(f"capacity {capacity} < total jagged length {n}")
+    values = np.zeros((capacity,), dtype)
+    if n:
+        values[:n] = np.concatenate([np.asarray(r, dtype) for r in rows])
+    return values, lengths
+
+
+def jagged_to_dense_per_host(values: jax.Array, lengths: jax.Array,
+                             max_len: int, pad_value=0,
+                             n_hosts: int = 1) -> jax.Array:
+    """:func:`jagged_to_dense` for values packed PER HOST.
+
+    On a multi-host mesh each process packs only its local rows into its own
+    ``capacity/n_hosts`` slice of the global values array (the slices line up
+    with the batch-axis sharding), so offsets restart at every host boundary
+    instead of running globally.  ``n_hosts=1`` is exactly
+    :func:`jagged_to_dense`.
+    """
+    if n_hosts <= 1:
+        return jagged_to_dense(values, lengths, max_len, pad_value)
+    b = lengths.shape[0]
+    rows_per_host = b // n_hosts
+    cap_per_host = values.shape[0] // n_hosts
+    off = jnp.cumsum(lengths, dtype=jnp.int32) - lengths  # global exclusive
+    host = jnp.arange(b, dtype=jnp.int32) // rows_per_host
+    host_start = jnp.take(off, host * rows_per_host)  # offset at host's row 0
+    local_off = off - host_start
+    base = host * cap_per_host + local_off  # [B] start of each row's values
+    pos = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    gather_idx = base[:, None] + pos
+    valid = pos < lengths[:, None]
+    dense = jnp.take(values, jnp.where(valid, gather_idx, 0), axis=0)
     mask = valid if dense.ndim == 2 else valid[..., None]
     return jnp.where(mask, dense, jnp.asarray(pad_value, dense.dtype))
 

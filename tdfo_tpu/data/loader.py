@@ -45,8 +45,16 @@ __all__ = [
 ]
 
 
-def _to_numpy_columns(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]:
-    """Arrow -> dict of numpy; fixed-width list columns become [B, T] arrays."""
+def _to_numpy_columns(batch: pa.RecordBatch | pa.Table,
+                      allow_ragged: bool = False) -> dict[str, np.ndarray]:
+    """Arrow -> dict of numpy; fixed-width list columns become [B, T] arrays.
+
+    With ``allow_ragged`` (the jagged training path), variable-length list
+    columns become object arrays of per-row numpy arrays — the shuffle/slice
+    machinery is row-indexed either way, and consumers pack them into
+    (values, lengths) at batch emit (``tdfo_tpu/data/jagged.py``).  Without
+    it, ragged data fails HERE with an actionable message instead of as an
+    obscure object-dtype error at device transfer."""
     out: dict[str, np.ndarray] = {}
     for name, col in zip(batch.schema.names, batch.columns):
         if pa.types.is_list(col.type) or pa.types.is_large_list(col.type):
@@ -55,9 +63,21 @@ def _to_numpy_columns(batch: pa.RecordBatch | pa.Table) -> dict[str, np.ndarray]
             offsets = arr.offsets.to_numpy(zero_copy_only=False)
             widths = np.diff(offsets)
             if len(widths) and (widths != widths[0]).any():
-                raise ValueError(
-                    f"list column {name!r} is ragged; pad it in preprocessing"
-                )
+                if not allow_ragged:
+                    raise ValueError(
+                        f"list column {name!r} is ragged; these shards were "
+                        "written for the jagged path (config jagged = true) "
+                        "— or pad them in preprocessing"
+                    )
+                # flatten() is slice-aware but .offsets is absolute: rebase
+                # so sliced arrays split correctly
+                rel = offsets - offsets[0]
+                rows = np.split(flat, rel[1:-1])
+                obj = np.empty(len(arr), dtype=object)
+                for i, r in enumerate(rows):
+                    obj[i] = r
+                out[name] = obj
+                continue
             t = int(widths[0]) if len(widths) else 0
             out[name] = flat.reshape(len(arr), t)
         else:
@@ -102,10 +122,12 @@ class ParquetStream:
         process_index: int | None = None,
         process_count: int | None = None,
         columns: Sequence[str] | None = None,
+        allow_ragged: bool = False,
     ):
         import jax
 
         self.files = list(files)
+        self.allow_ragged = allow_ragged
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.buffer_size = int(buffer_size)
@@ -133,7 +155,7 @@ class ParquetStream:
     def _file_batches(self, path: str):
         pf = pq.ParquetFile(path)
         for rb in pf.iter_batches(batch_size=65536, columns=self.columns):
-            yield _to_numpy_columns(rb)
+            yield _to_numpy_columns(rb, allow_ragged=self.allow_ragged)
 
     def _batches_per_host(self) -> int | None:
         """Cross-host batch budget from parquet metadata (no communication).
@@ -387,6 +409,11 @@ def prefetch_to_mesh(it, mesh, pspec=None, *, size: int = 2):
     keeps ``size`` batches in flight; jax dispatches transfers asynchronously
     so compute overlaps the next batch's copy.  Multihost: each host provides
     its local rows via ``make_array_from_process_local_data``.
+
+    Jagged batches need no special casing: per-host-packed ``values`` and
+    ``lengths`` both ship batch-sharded ``P("data")`` (each process provides
+    exactly its local slice), and ``jagged_to_dense_per_host`` reads the
+    host-segmented layout back inside the step.
     """
     import collections
 

@@ -113,26 +113,41 @@ def sliding_windows(
     labels: list[np.ndarray],
     max_len: int,
     step: int,
+    pad: bool = True,
 ) -> pd.DataFrame:
-    """Windows of ``max_len`` at stride ``step`` over each user's sequence,
-    PAD-padded — vectorised over all windows at once."""
+    """Windows of ``max_len`` at stride ``step`` over each user's sequence.
+
+    ``pad=True`` PAD-pads every window to ``max_len`` (offline padding, the
+    original recipe).  ``pad=False`` writes RAGGED windows — true lengths
+    only, no storage wasted on padding — for the runtime jagged path
+    (``Config.jagged``), where the trainer ships (values, lengths) and
+    ``jagged_to_dense`` runs inside the jitted step (torchrec KJT parity,
+    ``torchrec/train.py:33-41``).
+    """
     users, starts, seq_idx = [], [], []
     for i, (u, seq) in enumerate(zip(user_ids, inputs)):
         for s in range(0, max(len(seq), 1), step):
             users.append(u)
             starts.append(s)
             seq_idx.append(i)
-    win_items = np.full((len(starts), max_len), PAD_ID, np.int32)
-    win_labels = np.full((len(starts), max_len), PAD_ID, np.int32)
-    for row, (i, s) in enumerate(zip(seq_idx, starts)):
-        chunk = inputs[i][s : s + max_len]
-        win_items[row, : len(chunk)] = chunk
-        lab = labels[i][s : s + max_len]
-        win_labels[row, : len(lab)] = lab
+    if pad:
+        win_items = np.full((len(starts), max_len), PAD_ID, np.int32)
+        win_labels = np.full((len(starts), max_len), PAD_ID, np.int32)
+        for row, (i, s) in enumerate(zip(seq_idx, starts)):
+            chunk = inputs[i][s : s + max_len]
+            win_items[row, : len(chunk)] = chunk
+            lab = labels[i][s : s + max_len]
+            win_labels[row, : len(lab)] = lab
+        items_col, labels_col = list(win_items), list(win_labels)
+    else:
+        items_col = [inputs[i][s : s + max_len].astype(np.int32)
+                     for i, s in zip(seq_idx, starts)]
+        labels_col = [labels[i][s : s + max_len].astype(np.int32)
+                      for i, s in zip(seq_idx, starts)]
     return pd.DataFrame({
         "user_id": np.asarray(users, np.int32),
-        "train_interactions": list(win_items),
-        "labels": list(win_labels),
+        "train_interactions": items_col,
+        "labels": labels_col,
     })
 
 
@@ -219,8 +234,10 @@ def run_seq_preprocessing(
     mask_prob: float = 0.2,
     seed: int = 42,
     file_num: int = FILE_NUM,
+    pad: bool = True,
 ) -> dict[str, int]:
-    """Full ETL: raw interactions -> masked train windows + eval candidates."""
+    """Full ETL: raw interactions -> masked train windows + eval candidates.
+    ``pad=False`` writes ragged train windows for the runtime jagged path."""
     data_dir = Path(data_dir)
     rng = np.random.default_rng(seed)
 
@@ -234,7 +251,8 @@ def run_seq_preprocessing(
     split = split_leave_last_two(data)
     inputs, labels, ratio = mask_train_sequences(split, mask_prob, mask_id, rng)
     train_df = sliding_windows(
-        split["user_id"].to_numpy(), inputs, labels, max_len, sliding_step
+        split["user_id"].to_numpy(), inputs, labels, max_len, sliding_step,
+        pad=pad,
     )
     write_shards(data_dir, train_df, "train", file_num=file_num, seed=seed)
 

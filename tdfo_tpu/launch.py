@@ -69,7 +69,7 @@ def main(argv: list[str] | None = None) -> int:
 
         stats = run_seq_preprocessing(
             cfg.data_dir, max_len=cfg.max_len, sliding_step=cfg.sliding_step,
-            mask_prob=cfg.mask_prob, seed=cfg.seed,
+            mask_prob=cfg.mask_prob, seed=cfg.seed, pad=not cfg.jagged,
         )
         print(f"seq preprocessing: {stats}")
         return 0

@@ -154,6 +154,7 @@ def make_sharded_bert4rec(
     dtype=jnp.float32,
     attn: str = "full",
     fused_threshold: int | None = 16384,
+    a2a_capacity_factor: float | None = None,
 ):
     """The DMP-equivalent wiring (``torchrec/train.py:235-254``): item table in
     a ShardedEmbeddingCollection (sharded over ``model``), dense transformer
@@ -182,6 +183,7 @@ def make_sharded_bert4rec(
             )
         ],
         mesh=mesh,
+        a2a_capacity_factor=a2a_capacity_factor,
     )
     k_table, k_dense = jax.random.split(rng)
     tables = coll.init(k_table)

@@ -64,6 +64,7 @@ def make_sparse_train_step(
     mode: str = "gspmd",
     donate: bool = True,
     jit: bool = True,
+    batch_transform: Callable | None = None,
 ):
     """Build the jitted hybrid step.
 
@@ -76,7 +77,10 @@ def make_sparse_train_step(
     stochastic regularisation in this regime.
 
     ``batch`` must contain an id array for every feature the collection
-    serves (same key names).
+    serves (same key names) — or, with ``batch_transform``, whatever the
+    transform turns into one: the transform runs INSIDE the jitted step
+    (e.g. ``jagged_to_dense`` materialising [B, T] ids from a
+    (values, lengths) jagged batch, fbgemm ``jagged_2d_to_dense`` parity).
     """
     import inspect
 
@@ -84,6 +88,8 @@ def make_sparse_train_step(
     takes_rng = "dropout_rng" in inspect.signature(forward).parameters
 
     def step(state: SparseTrainState, batch, rng=None) -> tuple[SparseTrainState, jax.Array]:
+        if batch_transform is not None:
+            batch = batch_transform(batch)
         ids = {f: batch[f] for f in features}
         step_rng = None
         if takes_rng and rng is not None:

@@ -259,6 +259,7 @@ class Trainer:
             ctr_embedding_specs(cfg.size_map, cfg.embed_dim, sharding,
                                 fused_threshold=cfg.fused_table_threshold),
             mesh=self.mesh,
+            a2a_capacity_factor=cfg.a2a_capacity_factor or None,
         )
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)
@@ -332,6 +333,7 @@ class Trainer:
             jax.random.key(cfg.seed), self.model_cfg, self.mesh,
             sharding=sharding, attn=cfg.attn,
             fused_threshold=cfg.fused_table_threshold,
+            a2a_capacity_factor=cfg.a2a_capacity_factor or None,
         )
         self.state = SparseTrainState.create(
             dense_params=dense,
@@ -345,18 +347,37 @@ class Trainer:
                 "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
             ),
         )
+        # jagged mode: batches arrive as (values, lengths) pairs packed per
+        # host; jagged_to_dense runs INSIDE the jitted step (fbgemm
+        # jagged_2d_to_dense parity, torchrec/models.py:168-172)
+        transform = None
+        if cfg.jagged:
+            from tdfo_tpu.data.jagged import jagged_to_dense_per_host
+            from tdfo_tpu.models.bert4rec import PAD_ID
+
+            t_len, n_hosts = cfg.max_len, jax.process_count()
+
+            def transform(batch):
+                item = jagged_to_dense_per_host(
+                    batch["item_values"], batch["item_lengths"], t_len,
+                    PAD_ID, n_hosts)
+                label = jagged_to_dense_per_host(
+                    batch["label_values"], batch["item_lengths"], t_len,
+                    PAD_ID, n_hosts)
+                return {"item": item, "label": label}
+
         if cfg.steps_per_execution > 1:
             self.train_step = make_multi_step(
                 make_sparse_train_step(
                     self.coll, bert4rec_sparse_forward(self.backbone),
-                    mode=cfg.lookup_mode, jit=False,
+                    mode=cfg.lookup_mode, jit=False, batch_transform=transform,
                 ),
                 donate_state=False,
             )
         else:
             self.train_step = make_sparse_train_step(
                 self.coll, bert4rec_sparse_forward(self.backbone),
-                mode=cfg.lookup_mode, donate=False,
+                mode=cfg.lookup_mode, donate=False, batch_transform=transform,
             )
         self._dropout_rng = jax.random.key(cfg.seed + 1)
         self._stream_cls = ParquetStream  # seq ETL writes parquet only
@@ -387,7 +408,9 @@ class Trainer:
             )
             scores = score_candidates(logits, batch["cands"])
             labels = jnp.zeros_like(scores).at[:, 0].set(1.0)
-            m = recalls_and_ndcgs_for_ks(scores, labels, row_weights=w)
+            # ks from the same constant that seeds the accumulator dict
+            m = recalls_and_ndcgs_for_ks(scores, labels, ks=self._METRIC_KS,
+                                         row_weights=w)
             out = {"w_sum": acc["w_sum"] + w.sum()}
             for k, v in m.items():
                 out[k] = acc[k] + v * w.sum()
@@ -423,6 +446,7 @@ class Trainer:
             buffer_size=cfg.shuffle_buffer_size,
             seed=cfg.seed,
             drop_last=train,
+            allow_ragged=cfg.model == "bert4rec" and cfg.jagged,
         )
 
     def _train_batches(self, epoch: int) -> Iterator[tuple[dict, int]]:
@@ -436,7 +460,19 @@ class Trainer:
         cfg = self.config
         stream = self._stream(self._train_pattern, train=True)
         stream.set_epoch(epoch)
-        if cfg.model == "bert4rec":
+        if cfg.model == "bert4rec" and cfg.jagged:
+            from tdfo_tpu.data.jagged import pack_rows
+
+            cap = stream.batch_size * cfg.max_len  # static host capacity
+
+            def pack(b):
+                iv, il = pack_rows(list(b["train_interactions"]), cap)
+                lv, ll = pack_rows(list(b["labels"]), cap)
+                assert (il == ll).all(), "item/label window lengths diverged"
+                return {"item_values": iv, "item_lengths": il, "label_values": lv}
+
+            renamed = (pack(b) for b in stream)
+        elif cfg.model == "bert4rec":
             renamed = (
                 {"item": b["train_interactions"], "label": b["labels"]} for b in stream
             )

@@ -187,3 +187,31 @@ def test_table_wise_group_per_table_init_scales(mesh8):
             ],
             mesh=mesh8,
         )
+
+
+def test_alltoall_capacity_factor_drops_overflow(mesh8):
+    """Finite a2a_capacity_factor: balanced ids stay exact; under extreme
+    skew the ids past a bucket's capacity resolve to zero vectors (the
+    documented torchrec-planner-style trade)."""
+    specs = [EmbeddingSpec("item", 64, D, features=("item",), sharding="row")]
+    coll = ShardedEmbeddingCollection(specs, mesh=mesh8, a2a_capacity_factor=2.0)
+    tables = coll.init(jax.random.key(0))
+    run = jax.jit(lambda t, i: coll.lookup(t, {"item": i}, mode="alltoall")["item"])
+
+    # balanced ids: every shard's bucket fits in 2x the fair share -> exact
+    balanced = jnp.arange(64, dtype=jnp.int32) % 64
+    out = run(tables, balanced)
+    np.testing.assert_array_equal(np.asarray(out), reference_lookup(tables["item"], balanced))
+
+    # total skew: one shard owns every id; capacity = 2*64/2 = 64 -> with a
+    # 64-id batch nothing overflows, so shrink capacity by skewing MORE ids
+    # than cap: use factor so cap < n
+    coll2 = ShardedEmbeddingCollection(specs, mesh=mesh8, a2a_capacity_factor=0.5)
+    skew = jnp.zeros(64, jnp.int32)  # all ids -> shard 0; cap = 16 (0.5*64/2)
+    out2 = np.asarray(
+        jax.jit(lambda t, i: coll2.lookup(t, {"item": i}, mode="alltoall")["item"])(tables, skew)
+    )
+    ref_row = np.asarray(tables["item"][0])
+    n_exact = int((np.abs(out2 - ref_row[None, :]).max(axis=1) < 1e-7).sum())
+    n_zero = int((out2 == 0).all(axis=1).sum())
+    assert n_exact >= 16 and n_zero > 0 and n_exact + n_zero == 64, (n_exact, n_zero)
