@@ -55,8 +55,8 @@ class Config:
     Field-by-field parity sources:
       * data/paths + streaming: ``jax-flax/config.toml``, ``jax-flax/utils.py:10-33``
       * write_format / steps_per_execution / jit_xla / use_tpu:
-        ``tensorflow2/utils.py:10-38`` (jit_xla ``false -> None`` normalisation
-        kept at :func:`read_configs`)
+        ``tensorflow2/utils.py:10-38`` (jit_xla=false here means eager debug
+        execution — a REAL knob, unlike the reference's normalise-to-None)
       * sequence-model params (n_heads..mask_prob, model_parallel):
         ``torchrec/utils.py:8-34`` (incl. the ``max_len >= sliding_step`` assert)
     """
@@ -123,8 +123,21 @@ class Config:
     # steps (tensorflow2/utils.py steps_per_execution parity; a real TPU win
     # because per-step host round trips disappear)
     steps_per_execution: int = 1
+    # jit_xla = false -> the whole fit runs under jax.disable_jit(): op-by-op
+    # eager execution for debugging (tensorflow2/utils.py jit_compile=False
+    # parity; None/true = compiled, the default and the only sane production
+    # setting)
     jit_xla: bool | None = None
+    # use_tpu = true -> fail fast at Trainer construction unless jax's
+    # backend really is TPU (tensorflow2 TPUStrategy-resolution parity: the
+    # reference connected to a TPU cluster or died; silently training a
+    # "TPU" config on CPU is the failure mode this guards)
     use_tpu: bool = False
+    # PS-strategy parity (tensorflow2/train_ps.py:55-58 MinSizePartitioner):
+    # dense-regime variables whose per-shard size stays >= this many bytes
+    # are sharded over the model axis; 0 disables.  "Parameter servers" are
+    # just sharded arrays under GSPMD (SURVEY.md §2.3).
+    ps_min_shard_bytes: int = 0
     checkpoint_dir: str | None = None
     checkpoint_every_n_epochs: int = 10
     log_every_n_steps: int = 100
@@ -186,7 +199,6 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
     Reference-compatible behaviours preserved:
       * flat toml keys (no sections required); unknown keys are rejected so
         typos fail loudly (the reference dataclasses did this implicitly).
-      * ``jit_xla = false`` normalised to ``None`` (``tensorflow2/utils.py:36-37``).
       * ``size_map.json`` next to the data dir merged in when it exists.
       * a ``[mesh]`` table maps onto :class:`MeshSpec` (new capability).
     """
@@ -209,8 +221,6 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
 
-    if raw.get("jit_xla") is False:
-        raw["jit_xla"] = None
     if "data_dir" in raw:
         raw["data_dir"] = Path(raw["data_dir"]).expanduser()
 

@@ -62,13 +62,18 @@ def _to_numpy_columns(batch: pa.RecordBatch | pa.Table,
             flat = arr.flatten().to_numpy(zero_copy_only=False)
             offsets = arr.offsets.to_numpy(zero_copy_only=False)
             widths = np.diff(offsets)
-            if len(widths) and (widths != widths[0]).any():
-                if not allow_ragged:
-                    raise ValueError(
-                        f"list column {name!r} is ragged; these shards were "
-                        "written for the jagged path (config jagged = true) "
-                        "— or pad them in preprocessing"
-                    )
+            ragged = len(widths) and (widths != widths[0]).any()
+            if ragged and not allow_ragged:
+                raise ValueError(
+                    f"list column {name!r} is ragged; these shards were "
+                    "written for the jagged path (config jagged = true) "
+                    "— or pad them in preprocessing"
+                )
+            if allow_ragged:
+                # ALWAYS object rows under allow_ragged — an arrow batch
+                # whose rows coincidentally share one length must not switch
+                # representation mid-stream (the shuffle pool concatenates
+                # across batches and mixed ndim crashes it)
                 # flatten() is slice-aware but .offsets is absolute: rebase
                 # so sliced arrays split correctly
                 rel = offsets - offsets[0]
@@ -123,11 +128,18 @@ class ParquetStream:
         process_count: int | None = None,
         columns: Sequence[str] | None = None,
         allow_ragged: bool = False,
+        num_workers: int = 0,
     ):
         import jax
 
         self.files = list(files)
         self.allow_ragged = allow_ragged
+        # >0: that many background threads read files ahead of the consumer
+        # (order-preserving, so shuffles stay deterministic) — the
+        # capability the reference gets from tf.data num_parallel_reads /
+        # DataLoader num_workers; pyarrow/zlib release the GIL, so plain
+        # threads pipeline decode behind device compute.
+        self.num_workers = int(num_workers)
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
         self.buffer_size = int(buffer_size)
@@ -156,6 +168,58 @@ class ParquetStream:
         pf = pq.ParquetFile(path)
         for rb in pf.iter_batches(batch_size=65536, columns=self.columns):
             yield _to_numpy_columns(rb, allow_ragged=self.allow_ragged)
+
+    def _files_batches(self, files: Sequence[str]):
+        """All batches across ``files`` in order; with ``num_workers`` > 0 a
+        background thread per in-flight file decodes into a small BOUNDED
+        queue (never a whole materialised file), up to ``num_workers`` files
+        ahead of the consumer.  Order is preserved — determinism is part of
+        the loader's contract — and host memory stays O(num_workers x a few
+        arrow batches)."""
+        if self.num_workers <= 0:
+            for f in files:
+                yield from self._file_batches(f)
+            return
+        import collections
+        import queue as _queue
+        import threading
+
+        _END = object()
+
+        def start_reader(path: str):
+            q: _queue.Queue = _queue.Queue(maxsize=2)
+
+            def worker():
+                try:
+                    for d in self._file_batches(path):
+                        q.put(d)
+                    q.put(_END)
+                except BaseException as e:  # surfaced on the consumer side
+                    q.put(e)
+
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            return q
+
+        pending: collections.deque = collections.deque()
+        it = iter(files)
+        for _ in range(self.num_workers):
+            f = next(it, None)
+            if f is None:
+                break
+            pending.append(start_reader(f))
+        while pending:
+            q = pending.popleft()
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+            f = next(it, None)
+            if f is not None:
+                pending.append(start_reader(f))
 
     def _batches_per_host(self) -> int | None:
         """Cross-host batch budget from parquet metadata (no communication).
@@ -226,19 +290,18 @@ class ParquetStream:
 
         def raw_batches():
             stride_pos = 0
-            for f in files:
-                for d in self._file_batches(f):
-                    if not self._shard_by_file and self.process_count > 1:
-                        # strided slice so every host sees a disjoint subset
-                        n = len(next(iter(d.values())))
-                        idx = np.arange(
-                            (self.process_index - stride_pos) % self.process_count,
-                            n,
-                            self.process_count,
-                        )
-                        stride_pos = (stride_pos + n) % self.process_count
-                        d = _take(d, idx)
-                    yield d
+            for d in self._files_batches(files):
+                if not self._shard_by_file and self.process_count > 1:
+                    # strided slice so every host sees a disjoint subset
+                    n = len(next(iter(d.values())))
+                    idx = np.arange(
+                        (self.process_index - stride_pos) % self.process_count,
+                        n,
+                        self.process_count,
+                    )
+                    stride_pos = (stride_pos + n) % self.process_count
+                    d = _take(d, idx)
+                yield d
 
         pool: list[dict[str, np.ndarray]] = []
         pooled = 0

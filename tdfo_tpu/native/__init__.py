@@ -29,7 +29,7 @@ def _build() -> bool:
     _BUILD_DIR.mkdir(exist_ok=True)
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", str(_LIB_PATH),
-        str(_SRC),
+        str(_SRC), "-lz",
     ]
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
@@ -48,6 +48,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.tdfo_file_open.restype = ctypes.c_void_p
     lib.tdfo_file_close.argtypes = [ctypes.c_void_p]
     lib.tdfo_tfrecord_write.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
+    lib.tdfo_tfrecord_write_batch.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_uint64,
+    ]
+    lib.tdfo_tfrecord_write_batch.restype = ctypes.c_int64
     lib.tdfo_tfrecord_next_len.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
     lib.tdfo_tfrecord_read_payload.argtypes = [ctypes.c_void_p, u8p, ctypes.c_uint64]
     lib.tdfo_shuffle_rows.argtypes = [u8p, ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]

@@ -19,6 +19,8 @@
 #include <cstdio>
 #include <cstring>
 
+#include <zlib.h>
+
 extern "C" {
 
 // ---------------------------------------------------------------- crc32c
@@ -71,34 +73,50 @@ uint32_t tdfo_masked_crc32c(const uint8_t* data, uint64_t n) {
 }
 
 // ------------------------------------------------------------- tfrecord IO
+//
+// Files are zlib gzFile streams: mode "wb" writes gzip (the reference's
+// writer options, tensorflow2/data.py:114-116), "wbT" writes transparent
+// (uncompressed), and reads auto-detect either via gzread.  This makes the
+// native path cover the PRODUCTION format — the python gzip module never
+// enters the hot loop.
 
-// Append one framed record to an open FILE* (opaque handle from fopen).
-// Returns 0 on success.
 void* tdfo_file_open(const char* path, const char* mode) {
-  return (void*)fopen(path, mode);
+  return (void*)gzopen(path, mode);
 }
 
-int tdfo_file_close(void* f) { return fclose((FILE*)f); }
+int tdfo_file_close(void* f) { return gzclose((gzFile)f); }
 
 int tdfo_tfrecord_write(void* fv, const uint8_t* payload, uint64_t n) {
-  FILE* f = (FILE*)fv;
+  gzFile f = (gzFile)fv;
   uint8_t hdr[12];
   memcpy(hdr, &n, 8);
   uint32_t len_crc = tdfo_masked_crc32c(hdr, 8);
   memcpy(hdr + 8, &len_crc, 4);
-  if (fwrite(hdr, 1, 12, f) != 12) return 1;
-  if (n && fwrite(payload, 1, n, f) != n) return 2;
+  if (gzwrite(f, hdr, 12) != 12) return 1;
+  if (n && gzwrite(f, payload, (unsigned)n) != (int)n) return 2;
   uint32_t data_crc = tdfo_masked_crc32c(payload, n);
-  if (fwrite(&data_crc, 1, 4, f) != 4) return 3;
+  if (gzwrite(f, &data_crc, 4) != 4) return 3;
+  return 0;
+}
+
+// One call per SHARD: write n_records framed records; record i occupies
+// buf[offsets[i] .. offsets[i+1]).  Returns 0 on success, else the 1-based
+// index of the failing record.
+int64_t tdfo_tfrecord_write_batch(void* fv, const uint8_t* buf,
+                                  const uint64_t* offsets, uint64_t n_records) {
+  for (uint64_t i = 0; i < n_records; i++) {
+    uint64_t n = offsets[i + 1] - offsets[i];
+    if (tdfo_tfrecord_write(fv, buf + offsets[i], n) != 0) return (int64_t)(i + 1);
+  }
   return 0;
 }
 
 // Read the next record's length (verifying the length crc).  Returns 0 and
 // sets *len on success, 1 on clean EOF, negative on corruption.
 int tdfo_tfrecord_next_len(void* fv, uint64_t* len) {
-  FILE* f = (FILE*)fv;
+  gzFile f = (gzFile)fv;
   uint8_t hdr[12];
-  size_t got = fread(hdr, 1, 12, f);
+  int got = gzread(f, hdr, 12);
   if (got == 0) return 1;  // EOF
   if (got != 12) return -1;
   uint64_t n;
@@ -112,10 +130,10 @@ int tdfo_tfrecord_next_len(void* fv, uint64_t* len) {
 
 // Read payload of a record whose length was just returned; verifies data crc.
 int tdfo_tfrecord_read_payload(void* fv, uint8_t* out, uint64_t n) {
-  FILE* f = (FILE*)fv;
-  if (fread(out, 1, n, f) != n) return -1;
+  gzFile f = (gzFile)fv;
+  if (gzread(f, out, (unsigned)n) != (int)n) return -1;
   uint32_t crc_stored;
-  if (fread(&crc_stored, 1, 4, f) != 4) return -2;
+  if (gzread(f, &crc_stored, 4) != 4) return -2;
   if (tdfo_masked_crc32c(out, n) != crc_stored) return -3;
   return 0;
 }

@@ -102,6 +102,10 @@ class ShardedEmbeddingCollection:
             raise ValueError("duplicate table names")
         self.mesh = mesh
         self.axis = axis
+        # <= 0 means "exact" everywhere (the config knob documents 0 that
+        # way) — never let 0.0 slip through as a 1-element bucket capacity
+        if a2a_capacity_factor is not None and a2a_capacity_factor <= 0:
+            a2a_capacity_factor = None
         self.a2a_capacity_factor = a2a_capacity_factor
         self.n_shards = mesh.shape[axis] if mesh is not None else 1
         self._feature_to_table: dict[str, str] = {}

@@ -145,6 +145,12 @@ class Trainer:
 
     def __init__(self, config: Config, *, log_dir: str | Path | None = None):
         self.config = config
+        if config.use_tpu and jax.default_backend() != "tpu":
+            raise RuntimeError(
+                f"use_tpu = true but the jax backend is "
+                f"{jax.default_backend()!r} (TPUStrategy-resolution parity: "
+                "refuse to silently train a TPU config elsewhere)"
+            )
         self.mesh = make_mesh(config.mesh)
         self.logger = MetricLogger(log_dir or config.checkpoint_dir)
         self._ckpt = None
@@ -214,9 +220,23 @@ class Trainer:
             tx=make_adamw(cfg.learning_rate, cfg.weight_decay),
             loss_scale=loss_scale,
         )
-        self.state = jax.device_put(
-            state, NamedSharding(self.mesh, P())
-        )
+        if cfg.ps_min_shard_bytes > 0:
+            # PS-strategy parity (tensorflow2/train_ps.py:55-58): partition
+            # any variable big enough that a shard stays >= the threshold.
+            # Under GSPMD "parameter servers" are just sharded arrays; the
+            # optimizer state shards alongside each variable automatically
+            # (the plan maps over the whole state pytree).
+            from tdfo_tpu.parallel.sharding import (
+                min_size_partitioner_rule,
+                shard_state,
+            )
+
+            self.state = shard_state(
+                state, self.mesh,
+                min_size_partitioner_rule(self.mesh, cfg.ps_min_shard_bytes),
+            )
+        else:
+            self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
         if cfg.steps_per_execution > 1:
             self.train_step = make_multi_step(
                 make_train_step(mesh=self.mesh, jit=False)
@@ -446,7 +466,10 @@ class Trainer:
             buffer_size=cfg.shuffle_buffer_size,
             seed=cfg.seed,
             drop_last=train,
-            allow_ragged=cfg.model == "bert4rec" and cfg.jagged,
+            # eval shards are always fixed-length (padded seqs + candidate
+            # lists); only the jagged TRAIN stream opts into object columns
+            allow_ragged=train and cfg.model == "bert4rec" and cfg.jagged,
+            num_workers=cfg.num_workers,
         )
 
     def _train_batches(self, epoch: int) -> Iterator[tuple[dict, int]]:
@@ -468,7 +491,11 @@ class Trainer:
             def pack(b):
                 iv, il = pack_rows(list(b["train_interactions"]), cap)
                 lv, ll = pack_rows(list(b["labels"]), cap)
-                assert (il == ll).all(), "item/label window lengths diverged"
+                if (il != ll).any():  # data integrity, must survive python -O
+                    raise ValueError(
+                        "item/label window lengths diverged — mixed-version "
+                        "or corrupted jagged shards"
+                    )
                 return {"item_values": iv, "item_lengths": il, "label_values": lv}
 
             renamed = (pack(b) for b in stream)
@@ -497,7 +524,20 @@ class Trainer:
         for stack in prefetch_to_mesh(stacked(), self.mesh, P(None, "data")):
             yield stack, int(next(iter(stack.values())).shape[0])
 
+    def _jit_ctx(self):
+        """jit_xla = false -> the loop runs under jax.disable_jit(): op-by-op
+        eager execution for numerics debugging (TF jit_compile=False parity)."""
+        import contextlib
+
+        if self.config.jit_xla is False:
+            return jax.disable_jit()
+        return contextlib.nullcontext()
+
     def train_epoch(self, epoch: int) -> float:
+        with self._jit_ctx():
+            return self._train_epoch(epoch)
+
+    def _train_epoch(self, epoch: int) -> float:
         cfg = self.config
         t0 = time.perf_counter()
         # loss accumulates ON DEVICE; the only host syncs are at log
@@ -543,9 +583,10 @@ class Trainer:
     # ----------------------------------------------------------------- eval
 
     def evaluate(self, epoch: int) -> dict[str, float]:
-        if self.config.model == "bert4rec":
-            return self._evaluate_bert4rec(epoch)
-        return self._evaluate_twotower(epoch)
+        with self._jit_ctx():
+            if self.config.model == "bert4rec":
+                return self._evaluate_bert4rec(epoch)
+            return self._evaluate_twotower(epoch)
 
     def _eval_batches(self, rename: Callable[[dict], dict] | None = None) -> Iterator[dict]:
         """Padded, budgeted, mesh-sharded eval batches.

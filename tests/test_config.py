@@ -56,9 +56,10 @@ seed = 42
     assert cfg.model_parallel and cfg.max_len == 20
 
 
-def test_jit_xla_false_normalised(tmp_path: Path):
+def test_jit_xla_values_preserved(tmp_path: Path):
+    # false is a real value now (eager debug mode) — no normalise-to-None
     (tmp_path / "config.toml").write_text("jit_xla = false\n")
-    assert read_configs(tmp_path / "config.toml").jit_xla is None
+    assert read_configs(tmp_path / "config.toml").jit_xla is False
     (tmp_path / "config.toml").write_text("jit_xla = true\n")
     assert read_configs(tmp_path / "config.toml").jit_xla is True
 

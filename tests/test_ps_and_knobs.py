@@ -1,0 +1,116 @@
+"""PS-strategy parity + runtime-knob coverage.
+
+``ps_min_shard_bytes`` re-expresses TF's ParameterServerStrategy variable
+partitioning (``tensorflow2/train_ps.py:55-58`` ``MinSizePartitioner``,
+256 KB default) as a GSPMD sharding plan: big dense variables (and their
+optimizer slots) shard over the model axis, small ones replicate, and the
+training math is unchanged.  jit_xla / use_tpu / num_workers stopped being
+accepted-but-ignored keys: each has observable semantics tested here.
+"""
+
+import numpy as np
+import pytest
+
+from tdfo_tpu.core.config import read_configs
+from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+from tdfo_tpu.train.trainer import Trainer
+
+
+@pytest.fixture(scope="module")
+def ctr_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gr_ps")
+    write_synthetic_goodreads(d, n_users=100, n_books=150,
+                              interactions_per_user=(15, 50), seed=7)
+    size_map = run_ctr_preprocessing(d)
+    return d, size_map
+
+
+def _cfg(d, size_map, **kw):
+    base = dict(
+        data_dir=d, model="twotower", n_epochs=1, learning_rate=3e-3,
+        embed_dim=8, per_device_train_batch_size=16,
+        per_device_eval_batch_size=16, shuffle_buffer_size=500,
+        log_every_n_steps=1000, size_map=size_map,
+        mesh={"data": 4, "model": 2},
+    )
+    base.update(kw)
+    return read_configs(None, **base)
+
+
+def test_ps_partitioner_shards_large_variables_only(ctr_data):
+    import jax
+
+    d, size_map = ctr_data
+    # threshold chosen so the user/item tables qualify but tower kernels
+    # (8x8 = 256 B) do not
+    tr = Trainer(_cfg(d, size_map, ps_min_shard_bytes=512))
+    sharded, replicated = [], []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tr.state.params):
+        name = "/".join(str(getattr(k, "key", k)) for k in path)
+        n_bytes = leaf.size * leaf.dtype.itemsize
+        if any(ax is not None for ax in leaf.sharding.spec):
+            sharded.append((name, n_bytes))
+            assert n_bytes // 2 >= 512, (name, n_bytes)
+        else:
+            replicated.append((name, n_bytes))
+    assert sharded, "no variable was PS-partitioned"
+    assert any("embed" in n for n, _ in sharded)
+    # optimizer moments shard alongside their variables
+    mu_sharded = [
+        any(ax is not None for ax in leaf.sharding.spec)
+        for _, leaf in jax.tree_util.tree_leaves_with_path(tr.state.opt_state)
+        if leaf.ndim >= 1
+    ]
+    assert any(mu_sharded)
+
+
+def test_ps_partitioned_trajectory_matches_replicated(ctr_data):
+    d, size_map = ctr_data
+    loss_rep = Trainer(_cfg(d, size_map)).train_epoch(0)
+    loss_ps = Trainer(_cfg(d, size_map, ps_min_shard_bytes=512)).train_epoch(0)
+    assert np.isclose(loss_rep, loss_ps, rtol=1e-4), (loss_rep, loss_ps)
+
+
+def test_use_tpu_fails_fast_off_tpu(ctr_data):
+    d, size_map = ctr_data
+    with pytest.raises(RuntimeError, match="use_tpu"):
+        Trainer(_cfg(d, size_map, use_tpu=True))
+
+
+def test_jit_xla_false_runs_eagerly(ctr_data):
+    import jax
+    import jax.numpy as jnp
+
+    d, size_map = ctr_data
+    tr = Trainer(_cfg(d, size_map, jit_xla=False, shuffle_buffer_size=100,
+                      per_device_train_batch_size=8,
+                      per_device_eval_batch_size=8))
+    # under the trainer's context, jit is a no-op: the trace re-runs on
+    # every call instead of being compiled once and cached
+    traces = []
+
+    @jax.jit
+    def probe(x):
+        traces.append(1)
+        return x + 1
+
+    with tr._jit_ctx():
+        probe(jnp.zeros(()))
+        probe(jnp.zeros(()))
+    assert len(traces) == 2, "jit_xla=false must disable compilation caching"
+    metrics = tr.fit()
+    assert 0.0 <= metrics["auc"] <= 1.0
+
+
+def test_num_workers_preserves_order(ctr_data):
+    from tdfo_tpu.data.loader import ParquetStream, resolve_files
+
+    d, _ = ctr_data
+    files = resolve_files(d, "parquet/train_part_*.parquet")
+    base = ParquetStream(files, batch_size=32, shuffle=False, drop_last=False)
+    threaded = ParquetStream(files, batch_size=32, shuffle=False,
+                             drop_last=False, num_workers=3)
+    for a, b in zip(base, threaded):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
