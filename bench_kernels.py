@@ -16,43 +16,48 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _time_varying(f, inputs_list) -> float:
-    """min ms over calls with distinct inputs; first input used to compile."""
-    jax.block_until_ready(f(*inputs_list[0]))
-    times = []
-    for inputs in inputs_list[1:]:
-        t0 = time.perf_counter()
-        jax.block_until_ready(f(*inputs))
-        times.append(time.perf_counter() - t0)
-    return min(times) * 1e3
-
-
-def bench_flash(t: int = 4096, n_iters: int = 6) -> dict:
+def bench_flash(t: int = 4096) -> dict:
+    """Forward-only comparison, chain-differenced (block_until_ready does not
+    sync through the tunnelled runtime — see bench.py)."""
     from tdfo_tpu.ops.pallas_kernels import flash_attention
 
     b, h, dh = 1, 8, 64
-    inputs = []
-    for i in range(n_iters):
-        ks = jax.random.split(jax.random.key(i), 3)
-        inputs.append(tuple(
-            jax.random.normal(kk, (b, h, t, dh), jnp.bfloat16) for kk in ks
-        ))
-    jax.block_until_ready(inputs)
 
     def xla_attn(q, k, v):
         s = jnp.einsum("bhtd,bhsd->bhts", q, k).astype(jnp.float32) / dh**0.5
         return jnp.einsum("bhts,bhsd->bhtd", jax.nn.softmax(s, -1).astype(v.dtype), v)
 
-    pl_ms = _time_varying(
-        jax.jit(lambda q, k, v: flash_attention(q, k, v, None, 128, 128, False)),
-        inputs,
-    )
-    xla_ms = _time_varying(jax.jit(xla_attn), inputs)
+    def build(attn):
+        def run(kn):
+            @jax.jit
+            def chain(qs, ks_, vs):
+                def body(c, xs):
+                    q, kk, v = xs
+                    o = attn(q + c.astype(q.dtype), kk, v)
+                    return o.astype(jnp.float32).sum() % 1024.0, None
+
+                c, _ = jax.lax.scan(body, jnp.float32(0), (qs, ks_, vs))
+                return c
+
+            return chain
+
+        return run
+
+    def make_args(kn, seed):
+        xs = jax.random.split(jax.random.key(seed), 3)
+        q, kk, v = (jax.random.normal(x, (kn, b, h, t, dh), jnp.bfloat16) for x in xs)
+        float(jnp.sum(q.astype(jnp.float32)))
+        return (q, kk, v)
+
+    pl_sec = _chain_time(build(lambda q, k, v: flash_attention(q, k, v)),
+                         make_args, ks=(8, 32))
+    xla_sec = _chain_time(build(xla_attn), make_args, ks=(8, 32))
     return {
         "metric": f"flash_attention_T{t}_ms",
-        "value": round(pl_ms, 3),
+        "value": round(pl_sec * 1e3, 3),
         "unit": "ms",
-        "vs_baseline": round(xla_ms / pl_ms, 3),  # >1 = pallas faster
+        "xla_ms": round(xla_sec * 1e3, 3),
+        "vs_baseline": round(xla_sec / max(pl_sec, 1e-9), 3),  # >1 = pallas faster
     }
 
 
@@ -121,6 +126,53 @@ def bench_fat_adam(v: int = 2_000_000, d: int = 64, b: int = 8192) -> dict:
     }
 
 
+def bench_flash_bwd(t: int = 4096) -> dict:
+    """Training-direction comparison: flash fwd+bwd (both Pallas, O(T)
+    memory) vs the [T, T]-materialising XLA attention's VJP."""
+    from tdfo_tpu.ops.pallas_kernels import _xla_attention, flash_attention
+
+    b, h, dh = 1, 8, 64
+
+    def build(attn):
+        def run(k):
+            @jax.jit
+            def chain(qs, ks_, vs):
+                def body(c, xs):
+                    q, kk, v = xs
+
+                    def loss(q, kk, v):
+                        return (attn(q + c.astype(q.dtype), kk, v) ** 2).sum().astype(jnp.float32)
+
+                    _, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, kk, v)
+                    return (sum(g.astype(jnp.float32).sum() for g in grads) % 1024.0), None
+
+                c, _ = jax.lax.scan(body, jnp.float32(0), (qs, ks_, vs))
+                return c
+
+            return chain
+
+        return run
+
+    def make_args(k, seed):
+        xs = jax.random.split(jax.random.key(seed), 3)
+        q, kk, v = (jax.random.normal(x, (k, b, h, t, dh), jnp.bfloat16) for x in xs)
+        float(jnp.sum(q.astype(jnp.float32)))
+        return (q, kk, v)
+
+    pl_sec = _chain_time(build(lambda q, k, v: flash_attention(q, k, v)),
+                         make_args, ks=(4, 16))
+    xla_sec = _chain_time(build(lambda q, k, v: _xla_attention(q, k, v, None)),
+                          make_args, ks=(4, 16))
+    return {
+        "metric": f"flash_fwd_bwd_T{t}_ms",
+        "value": round(pl_sec * 1e3, 3),
+        "unit": "ms",
+        "xla_ms": round(xla_sec * 1e3, 3),
+        "vs_baseline": round(xla_sec / max(pl_sec, 1e-9), 3),  # >1 = pallas faster
+    }
+
+
 if __name__ == "__main__":
     print(json.dumps(bench_flash()))
+    print(json.dumps(bench_flash_bwd()))
     print(json.dumps(bench_fat_adam()))

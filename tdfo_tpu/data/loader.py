@@ -185,17 +185,31 @@ class ParquetStream:
         import threading
 
         _END = object()
+        # set when the consumer abandons the generator (exception mid-epoch,
+        # generator GC): workers must notice and exit instead of blocking on
+        # a full queue forever, pinning open readers and decoded batches
+        stop = threading.Event()
 
         def start_reader(path: str):
             q: _queue.Queue = _queue.Queue(maxsize=2)
 
+            def put(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+
             def worker():
                 try:
                     for d in self._file_batches(path):
-                        q.put(d)
-                    q.put(_END)
+                        if not put(d):
+                            return
+                    put(_END)
                 except BaseException as e:  # surfaced on the consumer side
-                    q.put(e)
+                    put(e)
 
             t = threading.Thread(target=worker, daemon=True)
             t.start()
@@ -203,23 +217,32 @@ class ParquetStream:
 
         pending: collections.deque = collections.deque()
         it = iter(files)
-        for _ in range(self.num_workers):
-            f = next(it, None)
-            if f is None:
-                break
-            pending.append(start_reader(f))
-        while pending:
-            q = pending.popleft()
-            while True:
-                item = q.get()
-                if item is _END:
+        try:
+            for _ in range(self.num_workers):
+                f = next(it, None)
+                if f is None:
                     break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-            f = next(it, None)
-            if f is not None:
                 pending.append(start_reader(f))
+            while pending:
+                q = pending.popleft()
+                while True:
+                    item = q.get()
+                    if item is _END:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    yield item
+                f = next(it, None)
+                if f is not None:
+                    pending.append(start_reader(f))
+        finally:
+            stop.set()
+            for q in pending:  # unblock any waiting worker
+                while not q.empty():
+                    try:
+                        q.get_nowait()
+                    except _queue.Empty:
+                        break
 
     def _batches_per_host(self) -> int | None:
         """Cross-host batch budget from parquet metadata (no communication).

@@ -286,6 +286,7 @@ def read_tfrecord_records(path: str | Path,
         if handle:
             try:
                 n = ctypes.c_uint64()
+                buf = (ctypes.c_uint8 * 4096)()  # grown as records demand
                 while True:
                     rc = lib.tdfo_tfrecord_next_len(handle, ctypes.byref(n))
                     if rc == 1:
@@ -294,11 +295,13 @@ def read_tfrecord_records(path: str | Path,
                         raise IOError(f"truncated tfrecord header in {path}")
                     if rc != 0:
                         raise IOError(f"tfrecord length crc mismatch ({rc})")
-                    out = (ctypes.c_uint8 * max(n.value, 1))()
-                    rc = lib.tdfo_tfrecord_read_payload(handle, out, n.value)
+                    if n.value > len(buf):
+                        buf = (ctypes.c_uint8 * n.value)()
+                    rc = lib.tdfo_tfrecord_read_payload(handle, buf, n.value)
                     if rc != 0:
                         raise IOError(f"tfrecord data crc mismatch ({rc})")
-                    yield bytes(bytearray(out)[: n.value])
+                    # single copy out of the reused buffer
+                    yield ctypes.string_at(buf, n.value)
             finally:
                 lib.tdfo_file_close(handle)
     opener = gzip.open if compression == "GZIP" else open

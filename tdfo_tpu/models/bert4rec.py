@@ -202,7 +202,7 @@ def make_sharded_bert4rec(
         def attn_fn(q, k, v, mask=None):
             key_valid = None if mask is None else mask[:, 0, 0, :]
             interp = jax.default_backend() != "tpu"
-            return flash_attention(q, k, v, key_valid, 128, 128, interp)
+            return flash_attention(q, k, v, key_valid, interpret=interp)
     elif attn == "full":
         attn_fn = dot_product_attention
     else:

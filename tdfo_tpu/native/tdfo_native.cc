@@ -86,6 +86,30 @@ void* tdfo_file_open(const char* path, const char* mode) {
 
 int tdfo_file_close(void* f) { return gzclose((gzFile)f); }
 
+// gzwrite/gzread take 32-bit lengths: chunk so multi-GiB payloads never
+// truncate silently.
+static int gz_write_all(gzFile f, const uint8_t* p, uint64_t n) {
+  const unsigned kChunk = 1u << 30;
+  while (n) {
+    unsigned take = n > kChunk ? kChunk : (unsigned)n;
+    if (gzwrite(f, p, take) != (int)take) return 1;
+    p += take;
+    n -= take;
+  }
+  return 0;
+}
+
+static int gz_read_all(gzFile f, uint8_t* p, uint64_t n) {
+  const unsigned kChunk = 1u << 30;
+  while (n) {
+    unsigned take = n > kChunk ? kChunk : (unsigned)n;
+    if (gzread(f, p, take) != (int)take) return 1;
+    p += take;
+    n -= take;
+  }
+  return 0;
+}
+
 int tdfo_tfrecord_write(void* fv, const uint8_t* payload, uint64_t n) {
   gzFile f = (gzFile)fv;
   uint8_t hdr[12];
@@ -93,7 +117,7 @@ int tdfo_tfrecord_write(void* fv, const uint8_t* payload, uint64_t n) {
   uint32_t len_crc = tdfo_masked_crc32c(hdr, 8);
   memcpy(hdr + 8, &len_crc, 4);
   if (gzwrite(f, hdr, 12) != 12) return 1;
-  if (n && gzwrite(f, payload, (unsigned)n) != (int)n) return 2;
+  if (n && gz_write_all(f, payload, n) != 0) return 2;
   uint32_t data_crc = tdfo_masked_crc32c(payload, n);
   if (gzwrite(f, &data_crc, 4) != 4) return 3;
   return 0;
@@ -131,7 +155,7 @@ int tdfo_tfrecord_next_len(void* fv, uint64_t* len) {
 // Read payload of a record whose length was just returned; verifies data crc.
 int tdfo_tfrecord_read_payload(void* fv, uint8_t* out, uint64_t n) {
   gzFile f = (gzFile)fv;
-  if (gzread(f, out, (unsigned)n) != (int)n) return -1;
+  if (gz_read_all(f, out, n) != 0) return -1;
   uint32_t crc_stored;
   if (gzread(f, &crc_stored, 4) != 4) return -2;
   if (tdfo_masked_crc32c(out, n) != crc_stored) return -3;
