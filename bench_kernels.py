@@ -9,7 +9,6 @@ measures the cache, not the chip.
 from __future__ import annotations
 
 import json
-import time
 
 import jax
 import jax.numpy as jnp

@@ -300,7 +300,6 @@ def _flash_bwd_impl(q, k, v, key_valid, out, lse, g, block_q, block_k, interpret
     scale = 1.0 / (dh**0.5)
     mask8 = jnp.broadcast_to(key_valid.astype(jnp.float32)[:, None, :], (b, 8, t))
     # lse already arrives in the [B, H, 8, T] sublane-broadcast layout
-    lse8 = lse
     delta8 = jnp.broadcast_to(delta[:, :, None, :], (b, h, 8, t))
 
     dq = pl.pallas_call(
@@ -320,7 +319,7 @@ def _flash_bwd_impl(q, k, v, key_valid, out, lse, g, block_q, block_k, interpret
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
-    )(mask8, lse8, delta8, q, k, v, g)
+    )(mask8, lse, delta8, q, k, v, g)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q, scale=scale),
@@ -344,7 +343,7 @@ def _flash_bwd_impl(q, k, v, key_valid, out, lse, g, block_q, block_k, interpret
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
         interpret=interpret,
-    )(mask8, lse8, delta8, q, k, v, g)
+    )(mask8, lse, delta8, q, k, v, g)
     return dq, dk, dv
 
 

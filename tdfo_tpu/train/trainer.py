@@ -140,6 +140,25 @@ def _make_ctr_eval_accum(logits_fn: Callable):
     return accum
 
 
+def _commit_replicated(state, mesh):
+    """Pin every uncommitted leaf of a state pytree to the mesh, replicated.
+
+    Sharded leaves (embedding tables placed by the collection) keep their
+    shardings; everything else (step counter, dense params, optax state,
+    count slots) commits as replicated.  Without this, checkpoint restore
+    materialises the uncommitted leaves on device 0 only and the next jitted
+    step fails with incompatible-device errors against the sharded tables.
+    """
+    repl = NamedSharding(mesh, P())
+
+    def commit(leaf):
+        if isinstance(leaf, jax.Array) and leaf.committed:
+            return leaf
+        return jax.device_put(leaf, repl)
+
+    return jax.tree.map(commit, state)
+
+
 class Trainer:
     """Config-driven trainer for both workload families."""
 
@@ -295,7 +314,7 @@ class Trainer:
         dummy_cont = {c: jnp.zeros((1,), jnp.float32) for c in TWOTOWER_CONTINUOUS}
         dense = backbone.init(k_dense, dummy_embs, dummy_cont)["params"]
         self.coll = coll
-        self.state = SparseTrainState.create(
+        self.state = _commit_replicated(SparseTrainState.create(
             dense_params=dense,
             tx=_optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
             tables=tables,
@@ -306,7 +325,7 @@ class Trainer:
             sparse_opt=sparse_optimizer(
                 "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
             ),
-        )
+        ), self.mesh)
         if cfg.steps_per_execution > 1:
             self.train_step = make_multi_step(
                 make_sparse_train_step(
@@ -355,7 +374,7 @@ class Trainer:
             fused_threshold=cfg.fused_table_threshold,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
         )
-        self.state = SparseTrainState.create(
+        self.state = _commit_replicated(SparseTrainState.create(
             dense_params=dense,
             tx=optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
             tables=tables,
@@ -366,7 +385,7 @@ class Trainer:
             sparse_opt=sparse_optimizer(
                 "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
             ),
-        )
+        ), self.mesh)
         # jagged mode: batches arrive as (values, lengths) pairs packed per
         # host; jagged_to_dense runs INSIDE the jitted step (fbgemm
         # jagged_2d_to_dense parity, torchrec/models.py:168-172)

@@ -33,9 +33,23 @@ def main() -> None:
     assert jax.process_count() == nprocs
     assert jax.local_device_count() == ndev
 
+    import json as _json
+    from pathlib import Path
+
     from tdfo_tpu.core.config import load_size_map, read_configs
     from tdfo_tpu.train.trainer import Trainer
 
+    if model == "bert4rec":
+        seq_map = _json.loads(
+            (Path(data_dir) / "size_map_bert4rec.json").read_text()
+        )
+        extra = dict(
+            size_map={"n_items": seq_map["n_items"]},
+            model_parallel=True, jagged=True, max_len=12, sliding_step=6,
+            n_heads=2, n_layers=1,
+        )
+    else:
+        extra = dict(size_map=load_size_map(data_dir))
     cfg = read_configs(
         None,
         data_dir=data_dir,
@@ -47,8 +61,8 @@ def main() -> None:
         per_device_eval_batch_size=8,
         shuffle_buffer_size=500,
         log_every_n_steps=10_000,
-        size_map=load_size_map(data_dir),
         mesh={"data": nprocs * ndev},
+        **extra,
     )
     tr = Trainer(cfg)
     pre = tr.evaluate(epoch=-1)  # deterministic init -> must be global-identical

@@ -49,3 +49,25 @@ def test_capacity_padding():
     assert jt.values.shape == (10,)
     dense = jt.to_dense(max_len=2)
     np.testing.assert_array_equal(dense, [[1, 0], [2, 3]])
+
+
+def test_jagged_to_dense_per_host_segmented_offsets():
+    """Per-host packing: offsets restart at every host boundary; the result
+    must equal the single-host conversion of the same logical rows."""
+    import numpy as np
+
+    from tdfo_tpu.data.jagged import jagged_to_dense, jagged_to_dense_per_host, pack_rows
+
+    rows = [np.array([1, 2, 3], np.int32), np.array([4], np.int32),
+            np.array([], np.int32), np.array([5, 6], np.int32)]
+    t = 4
+    # two hosts, two rows each, per-host capacity 2*t
+    v0, l0 = pack_rows(rows[:2], 2 * t)
+    v1, l1 = pack_rows(rows[2:], 2 * t)
+    values = jnp.concatenate([jnp.asarray(v0), jnp.asarray(v1)])
+    lengths = jnp.concatenate([jnp.asarray(l0), jnp.asarray(l1)])
+    got = np.asarray(jagged_to_dense_per_host(values, lengths, t, 0, n_hosts=2))
+
+    vg, lg = pack_rows(rows, 4 * t)
+    want = np.asarray(jagged_to_dense(jnp.asarray(vg), jnp.asarray(lg), t, 0))
+    np.testing.assert_array_equal(got, want)

@@ -95,3 +95,32 @@ def test_two_process_fit_and_global_metrics(ctr_data, tmp_path):
     for metric in one["pre"]:
         a, b = one["pre"][metric], two[0]["pre"][metric]
         assert np.isclose(a, b, rtol=1e-4, atol=1e-6), (metric, a, b)
+
+
+@pytest.fixture(scope="module")
+def seq_data(tmp_path_factory):
+    from tdfo_tpu.data.seq_preprocessing import run_seq_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+
+    d = tmp_path_factory.mktemp("gr_mh_seq")
+    write_synthetic_goodreads(d, n_users=100, n_books=120,
+                              interactions_per_user=(15, 40), seed=13)
+    run_seq_preprocessing(d, max_len=12, sliding_step=6, seed=13, pad=False)
+    return d
+
+
+def test_two_process_jagged_bert4rec(seq_data, tmp_path):
+    """The jagged path across REAL processes: per-host (values, lengths)
+    packing + jagged_to_dense_per_host's host-segmented offsets must agree —
+    a global-offset bug would silently garble one host's sequences."""
+    two = _run_workers(2, 2, seq_data, tmp_path, model="bert4rec")
+    assert two[0]["steps"] == two[1]["steps"] > 0
+    for key in ("pre", "post"):
+        for metric in two[0][key]:
+            a, b = two[0][key][metric], two[1][key][metric]
+            assert np.isclose(a, b, rtol=1e-6), (key, metric, a, b)
+    # training moved the model (post != pre for at least one metric)
+    assert any(
+        not np.isclose(two[0]["pre"][m], two[0]["post"][m], atol=1e-9)
+        for m in two[0]["pre"]
+    )
