@@ -512,7 +512,9 @@ def fat_adam_rows(
     assert d <= _LANE, "fat_adam_rows supports d <= 128; use the XLA fallback"
     u = uids.shape[0]
     sentinel = jnp.iinfo(jnp.int32).max
-    rows_per_step = min(rows_per_step, -(-u // 8) * 8)
+    # 2 buffers x rows semaphores must fit the chip's ~2KB sflag space
+    # (2x256 overflows it on v5e); 128 measured fastest anyway
+    rows_per_step = min(rows_per_step, 128, -(-u // 8) * 8)
     u_pad = -(-u // rows_per_step) * rows_per_step
     pad = u_pad - u
     uids_p = jnp.pad(uids.astype(jnp.int32), (0, pad), constant_values=sentinel)
@@ -530,51 +532,90 @@ def fat_adam_rows(
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased with fat
         scratch_shapes=[
-            pltpu.VMEM((rows_per_step, t_tiles, _LANE), jnp.float32),
-            pltpu.SemaphoreType.DMA((rows_per_step,)),
+            # DOUBLE-buffered row scratch: block i+1's reads overlap block
+            # i's compute, block i-1's writes drain one step behind
+            pltpu.VMEM((2, rows_per_step, t_tiles, _LANE), jnp.float32),
+            # ONE semaphore per (buffer, row) serves reads AND writes: on a
+            # given slot they strictly alternate (read.start/wait -> compute
+            # -> write.start, drained before the slot's next read), and two
+            # separate arrays would overflow the chip's semaphore space
+            pltpu.SemaphoreType.DMA((2, rows_per_step)),
         ],
     )
 
     def kernel(ids_ref, corr_ref, g_ref, fat_hbm, out_hbm, scratch, sems):
         i = pl.program_id(0)
-        for r in range(rows_per_step):
-            rid = ids_ref[i * rows_per_step + r]
-            # sentinel rows read row 0: cheap, and their write is masked off
-            read = jnp.where(rid < v_rows, rid, 0)
-            pltpu.make_async_copy(
-                fat_hbm.at[pl.ds(read, 1)], scratch.at[pl.ds(r, 1)], sems.at[r]
-            ).start()
-        for r in range(rows_per_step):
-            rid = ids_ref[i * rows_per_step + r]
-            read = jnp.where(rid < v_rows, rid, 0)
-            pltpu.make_async_copy(
-                fat_hbm.at[pl.ds(read, 1)], scratch.at[pl.ds(r, 1)], sems.at[r]
-            ).wait()
-        x = scratch[...]  # [rows, T, 128]
-        row, mu_r, nu_r = fat_components(x, d)
-        g_rows = g_ref[...].astype(jnp.float32)
-        # bias corrections precomputed outside (Mosaic has no runtime powf)
-        new = _adam_math(row, mu_r, nu_r, g_rows, corr_ref, lr=lr, b1=b1,
-                         b2=b2, eps=eps, weight_decay=weight_decay)
-        scratch[...] = fat_assemble(x, new, d)
-        for r in range(rows_per_step):
-            rid = ids_ref[i * rows_per_step + r]
+        nsteps = pl.num_programs(0)
 
-            @pl.when(rid < v_rows)
-            def _():
-                pltpu.make_async_copy(
-                    scratch.at[pl.ds(r, 1)], out_hbm.at[pl.ds(rid, 1)],
-                    sems.at[r],
-                ).start()
-        for r in range(rows_per_step):
-            rid = ids_ref[i * rows_per_step + r]
+        # helpers take a STATIC buffer parity (semaphore indices must be
+        # static) and a traced block index
+        def read_copy(block, p, r):
+            rid = ids_ref[block * rows_per_step + r]
+            # sentinel rows read row 0: cheap, their write is masked off
+            read = jnp.where(rid < v_rows, rid, 0)
+            return pltpu.make_async_copy(
+                fat_hbm.at[pl.ds(read, 1)], scratch.at[p, pl.ds(r, 1)],
+                sems.at[p, r],
+            )
 
-            @pl.when(rid < v_rows)
-            def _():
-                pltpu.make_async_copy(
-                    scratch.at[pl.ds(r, 1)], out_hbm.at[pl.ds(rid, 1)],
-                    sems.at[r],
-                ).wait()
+        def write_copy(block, p, r):
+            rid = ids_ref[block * rows_per_step + r]
+            return rid, pltpu.make_async_copy(
+                scratch.at[p, pl.ds(r, 1)], out_hbm.at[pl.ds(rid, 1)],
+                sems.at[p, r],
+            )
+
+        @pl.when(i == 0)
+        def _():
+            for r in range(rows_per_step):
+                read_copy(0, 0, r).start()
+
+        for p in (0, 1):  # parity of block i+1 (== parity of block i-1)
+            @pl.when(((i + 1) % 2 == p) & (i >= 1))
+            def _(p=p):
+                # buffer p is about to be reused: block i-1's writes out of
+                # it must land first
+                for r in range(rows_per_step):
+                    rid, cp = write_copy(i - 1, p, r)
+
+                    @pl.when(rid < v_rows)
+                    def _(cp=cp):
+                        cp.wait()
+
+            @pl.when(((i + 1) % 2 == p) & (i + 1 < nsteps))
+            def _(p=p):
+                for r in range(rows_per_step):
+                    read_copy(i + 1, p, r).start()
+
+        for p in (0, 1):  # parity of block i itself
+            @pl.when(i % 2 == p)
+            def _(p=p):
+                for r in range(rows_per_step):
+                    read_copy(i, p, r).wait()
+                x = scratch[p]  # [rows, T, 128]
+                row, mu_r, nu_r = fat_components(x, d)
+                g_rows = g_ref[...].astype(jnp.float32)
+                # bias corrections precomputed outside (no runtime powf)
+                new = _adam_math(row, mu_r, nu_r, g_rows, corr_ref, lr=lr,
+                                 b1=b1, b2=b2, eps=eps,
+                                 weight_decay=weight_decay)
+                scratch[p] = fat_assemble(x, new, d)
+                for r in range(rows_per_step):
+                    rid, cp = write_copy(i, p, r)
+
+                    @pl.when(rid < v_rows)
+                    def _(cp=cp):
+                        cp.start()
+
+                @pl.when(i == nsteps - 1)
+                def _(p=p):
+                    # no later step will drain the final block's writes
+                    for r in range(rows_per_step):
+                        rid, cp = write_copy(i, p, r)
+
+                        @pl.when(rid < v_rows)
+                        def _(cp=cp):
+                            cp.wait()
 
     return pl.pallas_call(
         kernel,

@@ -33,14 +33,13 @@ def main() -> None:
     assert jax.process_count() == nprocs
     assert jax.local_device_count() == ndev
 
-    import json as _json
     from pathlib import Path
 
     from tdfo_tpu.core.config import load_size_map, read_configs
     from tdfo_tpu.train.trainer import Trainer
 
     if model == "bert4rec":
-        seq_map = _json.loads(
+        seq_map = json.loads(
             (Path(data_dir) / "size_map_bert4rec.json").read_text()
         )
         extra = dict(

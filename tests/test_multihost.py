@@ -111,14 +111,22 @@ def seq_data(tmp_path_factory):
 
 def test_two_process_jagged_bert4rec(seq_data, tmp_path):
     """The jagged path across REAL processes: per-host (values, lengths)
-    packing + jagged_to_dense_per_host's host-segmented offsets must agree —
-    a global-offset bug would silently garble one host's sequences."""
+    packing + jagged_to_dense_per_host's host-segmented offsets must agree.
+    The single-process reference run is what actually detects an offset bug:
+    a garbled 2-process conversion would be deterministic and identical on
+    both hosts, so only divergence from the 1-process metrics exposes it."""
     two = _run_workers(2, 2, seq_data, tmp_path, model="bert4rec")
+    one = _run_workers(1, 4, seq_data, tmp_path, model="bert4rec")[0]
     assert two[0]["steps"] == two[1]["steps"] > 0
     for key in ("pre", "post"):
         for metric in two[0][key]:
             a, b = two[0][key][metric], two[1][key][metric]
             assert np.isclose(a, b, rtol=1e-6), (key, metric, a, b)
+    # pre-training eval (deterministic init, padded eval path is shared) must
+    # match the single-process run exactly
+    for metric in one["pre"]:
+        a, b = one["pre"][metric], two[0]["pre"][metric]
+        assert np.isclose(a, b, rtol=1e-4, atol=1e-6), (metric, a, b)
     # training moved the model (post != pre for at least one metric)
     assert any(
         not np.isclose(two[0]["pre"][m], two[0]["post"][m], atol=1e-9)

@@ -264,3 +264,30 @@ def test_flash_backward_padded_seq_len():
     gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("u", [129, 257, 400])
+def test_fat_adam_multi_block_pipeline(u):
+    """>128 touched rows forces multiple grid steps, exercising the
+    double-buffered steady state (block i-1 write drain, block i+1 read
+    prefetch, final-block drain) — not just the i==0 branch."""
+    rng = np.random.default_rng(u)
+    v, d = 512, 64
+    table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+    mu = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32)) * 0.1
+    nu = jnp.abs(jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))) * 0.1
+    ids = jnp.asarray(rng.choice(v, size=u, replace=False).astype(np.int32))
+    grads = jnp.asarray(rng.normal(size=(u, d)).astype(np.float32))
+    uids, g, valid = dedupe_grads(ids, grads)
+    count = jnp.asarray(4, jnp.int32)
+    t_ref, mu_ref, nu_ref, _ = sparse_adam(
+        table, mu, nu, count, uids, g, valid, lr=1e-2, weight_decay=0.01
+    )
+    fat_new = fat_adam_rows(
+        fat_pack(table, mu, nu), uids, g, count + 1, d=d, lr=1e-2,
+        weight_decay=0.01, interpret=True,
+    )
+    t_pl, mu_pl, nu_pl = fat_components(fat_new, d)
+    np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(mu_pl), np.asarray(mu_ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(nu_pl), np.asarray(nu_ref), rtol=1e-5, atol=1e-6)
