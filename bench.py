@@ -1,9 +1,11 @@
 """Benchmark harness — prints ONE JSON line with the headline metric.
 
-Headline: TwoTower CTR train-step throughput, examples/sec/chip on the real
-device, plus MFU, HBM utilisation vs the roofline floor, and the embedding
-lookup latency microbench (gspmd vs explicit psum vs all-to-all programs —
-the BASELINE.json metric family).
+Headline: CTR train-step throughput in the sparse/DMP regime (TwoTower by
+default; ``--model dlrm`` for the BASELINE.json north-star family;
+``--dense`` for the reference-parity dense regime), examples/sec/chip on the
+real device, plus MFU, HBM utilisation vs the roofline floor, the 100M-row
+big-table demo, and the embedding lookup latency microbench (gspmd vs
+explicit psum vs all-to-all programs — the BASELINE.json metric family).
 
 Measurement discipline — what the tunnelled TPU runtime actually does:
 
@@ -214,10 +216,13 @@ def build_train_bench(batch_size: int, embed_dim: int):
 # bound that is provably irreducible.
 
 
-def build_sparse_train_bench(batch_size: int, embed_dim: int):
+def build_sparse_train_bench(batch_size: int, embed_dim: int,
+                             model: str = "twotower"):
     """HEADLINE: the DMP regime — ShardedEmbeddingCollection + row-sparse
     in-backward Adam (``make_sparse_train_step``), the torchrec
-    ``DistributedModelParallel`` + fused-optimizer equivalent.
+    ``DistributedModelParallel`` + fused-optimizer equivalent.  ``model``
+    picks the CTR head: "twotower" or "dlrm" (the BASELINE.json north-star
+    family — feature-interaction head over the same 7 tables).
 
     Roofline floor recomputed for the sparse path: the optimizer only
     read-modify-writes the TOUCHED rows of table/mu/nu (6 x unique-rows x D x
@@ -243,7 +248,12 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int):
         ctr_embedding_specs(SIZE_MAP, embed_dim, "row"), mesh=mesh
     )
     tables = coll.init(jax.random.key(0))
-    backbone = TwoTowerBackbone(embed_dim=embed_dim, dtype=dtype)
+    if model == "dlrm":
+        from tdfo_tpu.models.dlrm import DLRMBackbone
+
+        backbone = DLRMBackbone(embed_dim=embed_dim, dtype=dtype)
+    else:
+        backbone = TwoTowerBackbone(embed_dim=embed_dim, dtype=dtype)
     dummy_embs = {f: jnp.zeros((1, embed_dim), jnp.float32) for f in coll.features()}
     dummy_cont = {"avg_rating": jnp.zeros((1,)), "num_pages": jnp.zeros((1,))}
     import optax
@@ -428,6 +438,9 @@ def main() -> None:
     ap.add_argument("--dense", action="store_true",
                     help="bench the dense regime (nn.Embed + dense AdamW) "
                          "instead of the sparse/DMP headline")
+    ap.add_argument("--model", default="twotower", choices=["twotower", "dlrm"],
+                    help="CTR head for the sparse headline (dlrm = the "
+                         "BASELINE.json north-star family)")
     ap.add_argument("--skip-big-table", action="store_true")
     args = ap.parse_args()
 
@@ -439,7 +452,7 @@ def main() -> None:
         )
     else:
         run, make_args, global_batch, floor_bytes, flops_per_ex = (
-            build_sparse_train_bench(args.batch_size, args.embed_dim)
+            build_sparse_train_bench(args.batch_size, args.embed_dim, args.model)
         )
     sec_per_step = chain_time(run, make_args)
     if callable(floor_bytes):  # sparse floor depends on the generated batches
@@ -476,8 +489,16 @@ def main() -> None:
 
     repo = Path(__file__).parent
     baseline_path = repo / "BENCH_BASELINE.json"
+    if args.dense and args.model != "twotower":
+        ap.error("--model is only valid for the sparse headline (drop --dense)")
+    model_name = "twotower" if args.dense else args.model
+    bench_config = {"batch_size": args.batch_size, "embed_dim": args.embed_dim}
+    if model_name != "twotower":
+        # a different model family must never be compared against the
+        # twotower baseline record (config equality gates vs_baseline)
+        bench_config["model"] = model_name
     record = {
-        "metric": "twotower_train_examples_per_sec_per_chip",
+        "metric": f"{model_name}_train_examples_per_sec_per_chip",
         "value": round(examples_per_sec_per_chip, 1),
         "unit": "examples/sec/chip",
         "regime": "dense_adamw" if args.dense else "dmp_sparse",
@@ -489,9 +510,14 @@ def main() -> None:
         "big_table_demo": big_table,
         "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
-        "config": {"batch_size": args.batch_size, "embed_dim": args.embed_dim},
+        "config": bench_config,
     }
-    if on_tpu and (args.write_baseline or not baseline_path.exists()):
+    # only the DEFAULT headline config may claim the auto-written baseline
+    # slot (a first-ever --model dlrm run must not disable twotower
+    # regression tracking); explicit --write-baseline always wins
+    default_cfg = model_name == "twotower" and not args.dense
+    if on_tpu and (args.write_baseline
+                   or (default_cfg and not baseline_path.exists())):
         baseline_path.write_text(json.dumps(record, indent=1) + "\n")
 
     vs_baseline = 1.0

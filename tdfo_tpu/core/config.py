@@ -111,6 +111,11 @@ class Config:
     # attention core for sequence models: "full" (T x T), "ring"
     # (sequence-parallel over the seq mesh axis), "flash" (Pallas O(T) kernel)
     attn: str = "full"
+    # Megatron-style tensor parallelism over the model axis for the sequence
+    # model's dense layers (feed-forward + vocab projection — the FLOPs peak
+    # and biggest dense param).  A sharding-spec change only; GSPMD inserts
+    # the collectives.  Beyond-reference capability (SURVEY.md §2.3: absent).
+    tensor_parallel: bool = False
     # vocab size above which DMP-regime tables use fused fat-row storage
     # (ops/pallas_kernels.fat_layout + the in-place DMA Adam kernel); smaller
     # tables take the one-hot MXU update.  The kernel choice itself is

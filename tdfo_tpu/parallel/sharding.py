@@ -27,6 +27,7 @@ __all__ = [
     "make_sharding_plan",
     "shard_state",
     "min_size_partitioner_rule",
+    "megatron_tp_rule",
 ]
 
 # A rule maps (path_string, leaf) -> PartitionSpec or None (meaning "no match").
@@ -85,6 +86,38 @@ def min_size_partitioner_rule(
         if nbytes // n < min_shard_bytes or leaf.shape[0] % n != 0:
             return None
         return P(axis, *([None] * (leaf.ndim - 1)))
+
+    return rule
+
+
+def megatron_tp_rule(mesh: Mesh, axis: str = MODEL_AXIS) -> PlanRule:
+    """Tensor parallelism for transformer dense layers (a capability the
+    reference lacks entirely — SURVEY.md §2.3 lists TP as absent).
+
+    The Megatron split expressed as sharding specs (GSPMD inserts the
+    collectives): feed-forward up-projections and the vocab output projection
+    shard their OUTPUT features over the model axis (column parallel, biases
+    shard along), the feed-forward down-projection shards its INPUT features
+    (row parallel, GSPMD psums the partial products).  On Bert4Rec the vocab
+    projection [D, V] is both the FLOPs peak and the largest dense parameter,
+    so this is where TP pays.
+    """
+    col = re.compile(r"(fc1|out_proj)/(kernel|bias)$")
+    row = re.compile(r"fc2/kernel$")
+
+    def rule(path: str, leaf) -> P | None:
+        if not hasattr(leaf, "ndim"):
+            return None
+        m = col.search(path)
+        if m:
+            if leaf.ndim == 2 and leaf.shape[1] % mesh.shape[axis] == 0:
+                return P(None, axis)
+            if leaf.ndim == 1 and leaf.shape[0] % mesh.shape[axis] == 0:
+                return P(axis)
+            return None
+        if row.search(path) and leaf.ndim == 2 and leaf.shape[0] % mesh.shape[axis] == 0:
+            return P(axis, None)
+        return None
 
     return rule
 

@@ -374,6 +374,11 @@ class Trainer:
             fused_threshold=cfg.fused_table_threshold,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
         )
+        if cfg.tensor_parallel:
+            from tdfo_tpu.parallel.sharding import megatron_tp_rule, shard_state
+
+            # optax moments mirror the params and inherit these shardings
+            dense = shard_state(dense, self.mesh, megatron_tp_rule(self.mesh))
         self.state = _commit_replicated(SparseTrainState.create(
             dense_params=dense,
             tx=optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),
