@@ -111,6 +111,10 @@ class Config:
     # attention core for sequence models: "full" (T x T), "ring"
     # (sequence-parallel over the seq mesh axis), "flash" (Pallas O(T) kernel)
     attn: str = "full"
+    # ring attention only: chunk each ring step's local attention to
+    # O(Tq x ring_block_k) logits with a rematerialised backward (0 = one
+    # chunk per ring step).  Must divide the per-device sequence length.
+    ring_block_k: int = 0
     # Megatron-style tensor parallelism over the model axis for the sequence
     # model's dense layers (feed-forward + vocab projection — the FLOPs peak
     # and biggest dense param).  A sharding-spec change only; GSPMD inserts
@@ -170,6 +174,10 @@ class Config:
             raise ValueError("jagged=true is a sequence-model knob (bert4rec)")
         if self.attn not in ("full", "ring", "flash"):
             raise ValueError(f"unknown attn: {self.attn!r}")
+        if self.ring_block_k < 0:
+            raise ValueError("ring_block_k must be >= 0 (0 = unchunked)")
+        if self.ring_block_k and self.attn != "ring":
+            raise ValueError("ring_block_k requires attn = \"ring\"")
         if self.steps_per_execution < 1:
             raise ValueError("steps_per_execution must be >= 1")
         if not self.streaming and self.write_format != "parquet":

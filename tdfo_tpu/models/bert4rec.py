@@ -155,6 +155,7 @@ def make_sharded_bert4rec(
     attn: str = "full",
     fused_threshold: int | None = 16384,
     a2a_capacity_factor: float | None = None,
+    ring_block_k: int | None = None,
 ):
     """The DMP-equivalent wiring (``torchrec/train.py:235-254``): item table in
     a ShardedEmbeddingCollection (sharded over ``model``), dense transformer
@@ -193,7 +194,7 @@ def make_sharded_bert4rec(
         # reference's full T×T attention.
         from tdfo_tpu.parallel.ring_attention import make_ring_attn_fn
 
-        attn_fn = make_ring_attn_fn(mesh)
+        attn_fn = make_ring_attn_fn(mesh, block_k=ring_block_k)
     elif attn == "flash":
         # single-device long-context path: Pallas blockwise online-softmax
         # kernel, O(T) memory (tdfo_tpu/ops/pallas_kernels.py)

@@ -38,6 +38,30 @@ __all__ = ["ring_attention", "ring_self_attention", "make_ring_attn_fn"]
 _NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
+def _accum_chunk(o, m, l, q, k_blk, v_blk, kv_valid, scale):
+    """One online-softmax accumulation over a K/V chunk (flash-style carry
+    update: running output ``o``, row max ``m``, normaliser ``l``)."""
+    logits = (
+        jnp.einsum("bhtd,bhsd->bhts", q, k_blk).astype(jnp.float32) * scale
+    )
+    logits = jnp.where(kv_valid[:, None, None, :], logits, _NEG_INF)
+    m_new = jnp.maximum(m, logits.max(axis=-1))  # [B, H, Tq]
+    # guard: rows where everything so far is masked keep m at _NEG_INF
+    # (finite finfo.min, same convention as the flash kernel); shifting by
+    # it would overflow exp, so clamp the shift and zero the correction.
+    # Threshold at _NEG_INF/2 so the guard holds for any all-masked row
+    # regardless of whether _NEG_INF is finite or a true -inf.
+    shift = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
+    probs = jnp.exp(logits - shift[..., None])
+    probs = jnp.where(kv_valid[:, None, None, :], probs, 0.0)
+    corr = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - shift))
+    l_new = l * corr + probs.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhts,bhsd->bhtd", probs.astype(v_blk.dtype), v_blk
+    ).astype(jnp.float32)
+    return o_new, m_new, l_new
+
+
 def ring_attention(
     q: jax.Array,  # [B, H, Tq, Dh] local query chunk
     k: jax.Array,  # [B, H, Tk, Dh] local key chunk
@@ -45,6 +69,7 @@ def ring_attention(
     key_valid: jax.Array | None = None,  # [B, Tk] True = attend (local chunk)
     *,
     axis_name: str = SEQ_AXIS,
+    block_k: int | None = None,
 ) -> jax.Array:
     """Blockwise attention with online softmax; K/V travel the ring.
 
@@ -52,39 +77,48 @@ def ring_attention(
     ``axis_name``.  Step ``s`` processes the K/V chunk originally owned by
     device ``(idx - s) mod P`` while asynchronously passing chunks to the next
     ring neighbour.
+
+    ``block_k`` additionally chunks each ring step's LOCAL attention: peak
+    logits memory drops from O(Tq x Tk) to O(Tq x block_k), and the inner
+    scan body is rematerialised (``jax.checkpoint``) so the backward pass
+    stays O(carry) instead of saving every chunk's probabilities — the
+    all-XLA counterpart of the Pallas flash kernel, composed with the ring.
+    Must divide the local Tk; identical numerics either way.
     """
     p = jax.lax.axis_size(axis_name)
     b, h, tq, dh = q.shape
+    tk = k.shape[2]
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     if key_valid is None:
         key_valid = jnp.ones(k.shape[:1] + k.shape[2:3], bool)  # [B, Tk]
+    if not block_k or block_k >= tk:
+        block_k = None  # 0/None/oversized all mean "one chunk per ring step"
+    elif tk % block_k:
+        raise ValueError(f"block_k {block_k} must divide the local K length {tk}")
 
     def block(carry, _):
         o, m, l, k_blk, v_blk, kv_valid = carry
-        logits = (
-            jnp.einsum("bhtd,bhsd->bhts", q, k_blk).astype(jnp.float32) * scale
-        )
-        logits = jnp.where(kv_valid[:, None, None, :], logits, _NEG_INF)
-        m_new = jnp.maximum(m, logits.max(axis=-1))  # [B, H, Tq]
-        # guard: rows where everything so far is masked keep m at _NEG_INF
-        # (finite finfo.min, same convention as the flash kernel); shifting by
-        # it would overflow exp, so clamp the shift and zero the correction.
-        # Threshold at _NEG_INF/2 so the guard holds for any all-masked row
-        # regardless of whether _NEG_INF is finite or a true -inf.
-        shift = jnp.where(m_new <= _NEG_INF / 2, 0.0, m_new)
-        probs = jnp.exp(logits - shift[..., None])
-        probs = jnp.where(kv_valid[:, None, None, :], probs, 0.0)
-        corr = jnp.where(m <= _NEG_INF / 2, 0.0, jnp.exp(m - shift))
-        l_new = l * corr + probs.sum(axis=-1)
-        o_new = o * corr[..., None] + jnp.einsum(
-            "bhts,bhsd->bhtd", probs.astype(v_blk.dtype), v_blk
-        ).astype(jnp.float32)
+        if block_k is None:
+            o, m, l = _accum_chunk(o, m, l, q, k_blk, v_blk, kv_valid, scale)
+        else:
+            nc = tk // block_k
+            kcs = jnp.moveaxis(k_blk.reshape(b, h, nc, block_k, dh), 2, 0)
+            vcs = jnp.moveaxis(v_blk.reshape(b, h, nc, block_k, dh), 2, 0)
+            validcs = jnp.moveaxis(kv_valid.reshape(b, nc, block_k), 1, 0)
+
+            @jax.checkpoint
+            def inner(c, xs):
+                oc, mc, lc = c
+                kc, vc, validc = xs
+                return _accum_chunk(oc, mc, lc, q, kc, vc, validc, scale), None
+
+            (o, m, l), _ = jax.lax.scan(inner, (o, m, l), (kcs, vcs, validcs))
         k_rot = jax.lax.ppermute(k_blk, axis_name, perm)
         v_rot = jax.lax.ppermute(v_blk, axis_name, perm)
         valid_rot = jax.lax.ppermute(kv_valid, axis_name, perm)
-        return (o_new, m_new, l_new, k_rot, v_rot, valid_rot), None
+        return (o, m, l, k_rot, v_rot, valid_rot), None
 
     o0 = jnp.zeros((b, h, tq, dh), jnp.float32)
     m0 = jnp.full((b, h, tq), _NEG_INF, jnp.float32)
@@ -104,6 +138,7 @@ def ring_self_attention(
     key_valid: jax.Array | None = None,  # [B, T] global
     *,
     axis: str = SEQ_AXIS,
+    block_k: int | None = None,
 ) -> jax.Array:
     """shard_map wrapper: shards T over ``axis``, runs the ring, returns the
     global [B, H, T, Dh] result.  T must divide by the axis size."""
@@ -113,7 +148,7 @@ def ring_self_attention(
         raise ValueError(f"sequence length {t} not divisible by seq axis {n}")
     qkv_spec = P(None, None, axis, None)
     valid_spec = P(None, axis)
-    fn = partial(ring_attention, axis_name=axis)
+    fn = partial(ring_attention, axis_name=axis, block_k=block_k)
     if key_valid is None:
         key_valid = jnp.ones((q.shape[0], t), bool)
     return jax.shard_map(
@@ -125,7 +160,8 @@ def ring_self_attention(
     )(q, k, v, key_valid)
 
 
-def make_ring_attn_fn(mesh: Mesh, axis: str = SEQ_AXIS):
+def make_ring_attn_fn(mesh: Mesh, axis: str = SEQ_AXIS,
+                      block_k: int | None = None):
     """Adapter matching the ``attn_fn(q, k, v, mask)`` contract of
     :class:`~tdfo_tpu.models.transformer.MultiHeadAttention`, so any
     transformer block (Bert4Rec included) switches to sequence parallelism by
@@ -141,6 +177,7 @@ def make_ring_attn_fn(mesh: Mesh, axis: str = SEQ_AXIS):
                     "ring attn_fn supports key-padding masks [B,1,1,T] only"
                 )
             key_valid = mask[:, 0, 0, :]
-        return ring_self_attention(mesh, q, k, v, key_valid, axis=axis)
+        return ring_self_attention(mesh, q, k, v, key_valid, axis=axis,
+                                   block_k=block_k)
 
     return attn_fn

@@ -373,6 +373,7 @@ class Trainer:
             sharding=sharding, attn=cfg.attn,
             fused_threshold=cfg.fused_table_threshold,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
+            ring_block_k=cfg.ring_block_k or None,
         )
         if cfg.tensor_parallel:
             from tdfo_tpu.parallel.sharding import megatron_tp_rule, shard_state
