@@ -67,7 +67,11 @@ def dedupe_grads(
     clean = jnp.where(ids >= 0, ids, oob)
     uids = jnp.unique(clean, size=capacity, fill_value=oob)  # sorted, oob last
     valid = uids < oob
-    seg = jnp.searchsorted(uids, clean)
+    # method="sort" is load-bearing: the default binary-search lowering costs
+    # ~0.86 ms for B=8192 on v5e (13 serial narrow gathers), vs ~0.14 ms for
+    # the sort-based counting method — measured 2.6x on the whole dedupe.
+    # Same indices either way, so downstream numerics are bit-identical.
+    seg = jnp.searchsorted(uids, clean, method="sort")
     if capacity < b and jax.default_backend() == "cpu":
         # Truncated REAL ids are exactly those searchsorted maps to index
         # ``capacity`` (the sentinel lands on a sentinel slot, not past the
