@@ -445,6 +445,9 @@ def main() -> None:
                          "BASELINE.json north-star family)")
     ap.add_argument("--skip-big-table", action="store_true")
     args = ap.parse_args()
+    if args.dense and args.model != "twotower":
+        # validate BEFORE measuring: a bad combination must not waste a run
+        ap.error("--model is only valid for the sparse headline (drop --dense)")
 
     import jax
 
@@ -491,8 +494,6 @@ def main() -> None:
 
     repo = Path(__file__).parent
     baseline_path = repo / "BENCH_BASELINE.json"
-    if args.dense and args.model != "twotower":
-        ap.error("--model is only valid for the sparse headline (drop --dense)")
     model_name = "twotower" if args.dense else args.model
     bench_config = {"batch_size": args.batch_size, "embed_dim": args.embed_dim}
     if model_name != "twotower":

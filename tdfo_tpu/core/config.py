@@ -65,6 +65,10 @@ class Config:
     data_dir: Path = Path("data/goodreads")
     train_data: str = "train_part_*.parquet"
     eval_data: str = "eval_part_*.parquet"
+    # held-out TEST split (bert4rec leave-last-one): evaluated ONCE after
+    # fit() finishes.  The reference computes this split and never consumes
+    # it (torchrec/train.py:147-177); empty string disables.
+    test_data: str = "test_part_*.parquet"
     streaming: bool = True
     write_format: str = "parquet"
     num_workers: int = 0
@@ -172,6 +176,14 @@ class Config:
             raise ValueError("a2a_capacity_factor must be >= 0 (0 = exact)")
         if self.jagged and self.model != "bert4rec":
             raise ValueError("jagged=true is a sequence-model knob (bert4rec)")
+        if self.model == "bert4rec" and self.write_format != "parquet":
+            # the seq ETL writes list-valued columns, which the TFRecord
+            # sidecar schema does not carry — rejected rather than silently
+            # reading parquet anyway (every config key must DO something)
+            raise ValueError(
+                "model=\"bert4rec\" supports write_format=\"parquet\" only "
+                "(sequence columns are list-valued)"
+            )
         if self.attn not in ("full", "ring", "flash"):
             raise ValueError(f"unknown attn: {self.attn!r}")
         if self.ring_block_k < 0:

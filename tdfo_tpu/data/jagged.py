@@ -12,9 +12,10 @@ XLA:
     free under XLA fusion, so the canonical representation is
     ``(values[N], lengths[B])``.
   * ``jagged_to_dense`` / ``dense_to_jagged`` (fbgemm kernel parity,
-    ``torchrec/models.py:168-172``) are expressed as gather/scatter with
-    static ``max_len`` so they tile onto the VPU; a Pallas variant lives in
-    ``tdfo_tpu/ops`` for the large-batch hot path.
+    ``torchrec/models.py:168-172``) are expressed as single fused gathers
+    with static ``max_len`` so they tile onto the VPU and fuse into
+    neighbouring ops — deliberately NOT Pallas kernels: XLA already lowers
+    a one-gather formulation well, and row gathers are fast on v5e.
 """
 
 from __future__ import annotations
@@ -141,6 +142,12 @@ def jagged_to_dense_per_host(values: jax.Array, lengths: jax.Array,
     if n_hosts <= 1:
         return jagged_to_dense(values, lengths, max_len, pad_value)
     b = lengths.shape[0]
+    if b % n_hosts or values.shape[0] % n_hosts:
+        raise ValueError(
+            f"jagged_to_dense_per_host: batch ({b}) and values capacity "
+            f"({values.shape[0]}) must both divide by n_hosts ({n_hosts}); "
+            "uneven splits would mis-segment host boundaries"
+        )
     rows_per_host = b // n_hosts
     cap_per_host = values.shape[0] // n_hosts
     off = jnp.cumsum(lengths, dtype=jnp.int32) - lengths  # global exclusive

@@ -162,15 +162,33 @@ def eval_sequences(split: pd.DataFrame, max_len: int, mask_id: int) -> list[np.n
     return seqs
 
 
+def test_sequences(split: pd.DataFrame, max_len: int, mask_id: int) -> list[np.ndarray]:
+    """Leave-one-out TEST inputs: by test time the eval item is known history,
+    so the window is (train + eval_item) tail + MASK.  The reference computes
+    its test split and never consumes it (``train_val_test`` neither writes
+    nor evaluates it, ``/root/reference/torchrec/train.py:147-177``) — this
+    framework writes test shards and runs a final post-fit test evaluation."""
+    seqs = []
+    for seq, ev in zip(split["train"], split["eval_item"]):
+        hist = np.concatenate([seq, [ev]])
+        tail = np.concatenate([hist[-(max_len - 1):], [mask_id]]).astype(np.int32)
+        out = np.full((max_len,), PAD_ID, np.int32)
+        out[-len(tail):] = tail
+        seqs.append(out)
+    return seqs
+
+
 def sample_negatives(
     split: pd.DataFrame,
     items: np.ndarray,
     probs: np.ndarray,
     rng: np.random.Generator,
     n_neg: int = EVAL_NEG_NUM,
+    extra_positives: list[np.ndarray] | None = None,
 ) -> list[np.ndarray]:
     """Per user: ``n_neg`` unique popularity-weighted negatives excluding the
-    user's positives (train + eval item).
+    user's positives (train + eval item, plus ``extra_positives`` rows — the
+    test split passes the test item so test candidates never leak it).
 
     Shared-pool amortisation (the reference's scheme, ``:260-299``): weighted
     no-replacement draws cost O(n_items) each, so one pool serves many users —
@@ -196,19 +214,21 @@ def sample_negatives(
             have += chunk
         pool = np.concatenate(parts)
 
+    extras = extra_positives or [np.empty((0,), np.int32)] * len(split)
     out = []
-    for seq, ev, need in zip(split["train"], split["eval_item"], needs):
+    for seq, ev, extra, need in zip(split["train"], split["eval_item"], extras, needs):
         pos = set(seq.tolist())
         pos.add(int(ev))
+        pos.update(int(x) for x in np.atleast_1d(extra))
         want = min(n_neg, n_avail - len(pos))
         refill(need)
         slice_, pool = pool[:need], pool[need:]
         keep = pd.unique(slice_[~np.isin(slice_, list(pos))])[:n_neg]
         while len(keep) < want:  # rare: slack eaten by overlap/duplicates
             refill(chunk)
-            extra, pool = pool[:chunk], pool[chunk:]
-            extra = extra[~np.isin(extra, list(pos))]
-            keep = pd.unique(np.concatenate([keep, extra]))[:n_neg]
+            top_up, pool = pool[:chunk], pool[chunk:]
+            top_up = top_up[~np.isin(top_up, list(pos))]
+            keep = pd.unique(np.concatenate([keep, top_up]))[:n_neg]
         if len(keep) < n_neg:  # tiny catalog: duplicate rather than go ragged
             keep = np.resize(keep, n_neg)
         out.append(keep.astype(np.int32))
@@ -267,4 +287,23 @@ def run_seq_preprocessing(
         ],
     })
     write_shards(data_dir, eval_df, "eval", file_num=file_num, seed=seed)
+
+    # test split (leave-last-one): the reference computes test_item and drops
+    # it (torchrec/preprocessing.py:83-109, train.py:147-177); here it is
+    # written with the SAME column names as eval so the trainer's eval
+    # machinery serves both by swapping the file pattern.
+    tst_seqs = test_sequences(split, max_len, mask_id)
+    tst_negs = sample_negatives(
+        split, items, probs, rng,
+        extra_positives=[np.asarray([t], np.int32) for t in split["test_item"]],
+    )
+    test_df = pd.DataFrame({
+        "user_id": split["user_id"],
+        "eval_seqs": tst_seqs,
+        "candidate_items": [
+            np.concatenate([[t], ng]).astype(np.int32)
+            for t, ng in zip(split["test_item"], tst_negs)
+        ],
+    })
+    write_shards(data_dir, test_df, "test", file_num=file_num, seed=seed)
     return {"n_users": n_users, "n_items": n_items, "masked_ratio": ratio}

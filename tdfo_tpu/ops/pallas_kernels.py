@@ -551,8 +551,10 @@ def fat_adam_rows(
         # static) and a traced block index
         def read_copy(block, p, r):
             rid = ids_ref[block * rows_per_step + r]
-            # sentinel rows read row 0: cheap, their write is masked off
-            read = jnp.where(rid < v_rows, rid, 0)
+            # sentinel/out-of-range rows read row 0: cheap, write masked
+            # off.  The >= 0 clause keeps a stray NEGATIVE id (excluded by
+            # dedupe_grads, but not by the stated uids contract) in bounds.
+            read = jnp.where((rid >= 0) & (rid < v_rows), rid, 0)
             return pltpu.make_async_copy(
                 fat_hbm.at[pl.ds(read, 1)], scratch.at[p, pl.ds(r, 1)],
                 sems.at[p, r],
@@ -578,7 +580,7 @@ def fat_adam_rows(
                 for r in range(rows_per_step):
                     rid, cp = write_copy(i - 1, p, r)
 
-                    @pl.when(rid < v_rows)
+                    @pl.when((rid >= 0) & (rid < v_rows))
                     def _(cp=cp):
                         cp.wait()
 
@@ -603,7 +605,7 @@ def fat_adam_rows(
                 for r in range(rows_per_step):
                     rid, cp = write_copy(i, p, r)
 
-                    @pl.when(rid < v_rows)
+                    @pl.when((rid >= 0) & (rid < v_rows))
                     def _(cp=cp):
                         cp.start()
 
@@ -613,7 +615,7 @@ def fat_adam_rows(
                     for r in range(rows_per_step):
                         rid, cp = write_copy(i, p, r)
 
-                        @pl.when(rid < v_rows)
+                        @pl.when((rid >= 0) & (rid < v_rows))
                         def _(cp=cp):
                             cp.wait()
 

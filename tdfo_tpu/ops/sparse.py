@@ -40,19 +40,20 @@ __all__ = [
 
 
 def dedupe_grads(
-    ids: jax.Array, grads: jax.Array, *, capacity: int | None = None
+    ids: jax.Array, grads: jax.Array, *, capacity: int | None = None,
+    vocab: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Merge duplicate row ids: ``(ids[B], grads[B,D]) -> (uids[U], g[U,D], valid[U])``.
 
     ``capacity`` is the static unique bound (defaults to ``B``).  It MUST be
     >= the true distinct-id count: ``jnp.unique(size=...)`` truncates the
     tail, and the searchsorted below maps every truncated id to index
-    ``capacity``, whose update the scatter silently drops — undersizing loses
-    gradient mass without error.  The default ``capacity=B`` is always safe;
-    pass a smaller value only with a proven bound (e.g. a vocab smaller than
-    the batch).  On CPU backends (tests, spoofed meshes) a runtime tripwire
-    warns when the bound is violated; it is compiled out on TPU because the
-    tunnelled runtime rejects host callbacks.
+    ``capacity``, whose update the scatter silently drops — undersizing would
+    lose gradient mass without error.  An undersized capacity is therefore a
+    TRACE-TIME error unless a static bound proves it safe: pass ``vocab`` (the
+    table's row count — distinct ids can never exceed it) to license
+    ``capacity >= vocab`` with ``vocab < B``.  The default ``capacity=B`` is
+    always safe.
 
     Negative (padding) ids are remapped to an out-of-bounds sentinel *before* the
     unique so sortedness holds for the searchsorted below; sentinel slots get
@@ -63,6 +64,13 @@ def dedupe_grads(
     """
     b = ids.shape[0]
     capacity = capacity or b
+    if capacity < b and (vocab is None or capacity < vocab):
+        raise ValueError(
+            f"dedupe_grads: capacity {capacity} < batch {b} is only safe when "
+            f"a static bound proves distinct ids fit (vocab <= capacity); "
+            f"got vocab={vocab}.  Undersizing silently DROPS the largest-id "
+            "updates, so it is rejected at trace time."
+        )
     oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
     clean = jnp.where(ids >= 0, ids, oob)
     uids = jnp.unique(clean, size=capacity, fill_value=oob)  # sorted, oob last
@@ -72,21 +80,6 @@ def dedupe_grads(
     # the sort-based counting method — measured 2.6x on the whole dedupe.
     # Same indices either way, so downstream numerics are bit-identical.
     seg = jnp.searchsorted(uids, clean, method="sort")
-    if capacity < b and jax.default_backend() == "cpu":
-        # Truncated REAL ids are exactly those searchsorted maps to index
-        # ``capacity`` (the sentinel lands on a sentinel slot, not past the
-        # end, so it never false-positives).  debug.print needs host
-        # callbacks, which the tunnelled TPU runtime lacks — CPU-only.
-        overflow = ((seg == capacity) & (clean < oob)).any()
-        jax.lax.cond(
-            overflow,
-            lambda: jax.debug.print(
-                "WARNING dedupe_grads: distinct ids exceed capacity "
-                f"({capacity}); largest-id updates are being DROPPED",
-                ordered=False,
-            ),
-            lambda: None,
-        )
     g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
     g = jnp.where(valid[:, None], g, 0.0)
     return uids, g, valid
@@ -209,7 +202,8 @@ def fat_adam_update(fat, count, ids, grads, *, embedding_dim, lr, b1=0.9,
 
     d = embedding_dim
     uids, g, valid = dedupe_grads(
-        ids.reshape(-1), grads.reshape(-1, grads.shape[-1]), capacity=capacity
+        ids.reshape(-1), grads.reshape(-1, grads.shape[-1]), capacity=capacity,
+        vocab=fat.shape[0],
     )
     new_count = count + 1
     if jax.default_backend() == "tpu" and d <= 128:
@@ -296,7 +290,7 @@ class SparseOptimizer:
             )
             return table, (mu, nu, count)
         uids, g, valid = dedupe_grads(ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
-                                      capacity=capacity)
+                                      capacity=capacity, vocab=table.shape[0])
         if self.kind == "sgd":
             return sparse_sgd(table, uids, g, valid, lr=self.lr,
                               weight_decay=self.weight_decay), slots

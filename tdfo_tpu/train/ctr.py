@@ -24,14 +24,16 @@ from tdfo_tpu.train.step import bce_with_logits_loss
 __all__ = ["ctr_sparse_forward", "make_ctr_sparse_eval_step"]
 
 
-def ctr_sparse_forward(backbone) -> Callable:
+def ctr_sparse_forward(backbone, with_logits: bool = False) -> Callable:
     """Forward for ``make_sparse_train_step``: the collection has already
     gathered the categorical vectors; run the dense backbone (TwoTowerBackbone
-    or DLRMBackbone — both take ``(embs, batch)``) and the sigmoid BCE."""
+    or DLRMBackbone — both take ``(embs, batch)``) and the sigmoid BCE.
+    ``with_logits=True`` returns ``(loss, logits)`` for ``with_aux`` steps."""
 
     def forward(dense_params, embs, batch):
         logits = backbone.apply({"params": dense_params}, embs, batch)
-        return bce_with_logits_loss(logits, batch["label"].astype(jnp.float32))
+        loss = bce_with_logits_loss(logits, batch["label"].astype(jnp.float32))
+        return (loss, logits) if with_logits else loss
 
     return forward
 

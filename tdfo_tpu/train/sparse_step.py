@@ -65,6 +65,7 @@ def make_sparse_train_step(
     donate: bool = True,
     jit: bool = True,
     batch_transform: Callable | None = None,
+    with_aux: bool = False,
 ):
     """Build the jitted hybrid step.
 
@@ -81,6 +82,10 @@ def make_sparse_train_step(
     transform turns into one: the transform runs INSIDE the jitted step
     (e.g. ``jagged_to_dense`` materialising [B, T] ids from a
     (values, lengths) jagged batch, fbgemm ``jagged_2d_to_dense`` parity).
+
+    ``with_aux=True``: ``forward`` must return ``(loss, aux)`` and the step
+    returns ``(state, (loss, aux))`` — the hook for per-epoch TRAIN metrics
+    (reference parity: train-side ROC-AUC, ``jax-flax/train_dp.py:219-220``).
     """
     import inspect
 
@@ -102,9 +107,12 @@ def make_sparse_train_step(
             return forward(dense_params, embs, batch)
 
         embs = coll.lookup(state.tables, ids, mode=mode)
-        loss, (g_dense, g_embs) = jax.value_and_grad(loss_from_embs, argnums=(0, 1))(
-            state.dense_params, embs
-        )
+        loss, (g_dense, g_embs) = jax.value_and_grad(
+            loss_from_embs, argnums=(0, 1), has_aux=with_aux
+        )(state.dense_params, embs)
+        aux = None
+        if with_aux:
+            loss, aux = loss
 
         # dense half: optax
         updates, new_opt_state = state.tx.update(g_dense, state.opt_state, state.dense_params)
@@ -142,7 +150,7 @@ def make_sparse_train_step(
                 tx=state.tx,
                 sparse_opt=state.sparse_opt,
             ),
-            loss,
+            (loss, aux) if with_aux else loss,
         )
 
     if not jit:

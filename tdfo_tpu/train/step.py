@@ -41,6 +41,7 @@ def make_train_step(
     mesh: Mesh | None = None,
     donate_state: bool = True,
     jit: bool = True,
+    with_aux: bool = False,
 ):
     """Build the jitted train step.
 
@@ -50,8 +51,14 @@ def make_train_step(
     replicate/shard/prefetch plumbing of ``jax-flax/train_dp.py:186,210-211``
     reduced to sharding annotations); parameter shardings are taken from the
     arrays themselves so model-parallel params keep their specs.
+
+    ``with_aux=True``: ``loss_fn`` must return ``(scalar, aux)`` (the default
+    returns the logits as aux) and the step returns ``(state, (loss, aux))``
+    — how the trainer streams per-epoch TRAIN metrics (the reference computes
+    train-side ROC-AUC every epoch, ``jax-flax/train_dp.py:190,219-220``)
+    without a second forward pass.
     """
-    loss_fn = loss_fn or _default_loss
+    loss_fn = loss_fn or (_default_loss_aux if with_aux else _default_loss)
 
     def step(state: TrainState, batch) -> tuple[TrainState, jax.Array]:
         if mesh is not None:
@@ -60,10 +67,13 @@ def make_train_step(
             )
 
         def scaled_loss(params):
-            loss = loss_fn(params, state.apply_fn, batch)
-            return scale_loss(loss, state.loss_scale)
+            out = loss_fn(params, state.apply_fn, batch)
+            loss, aux = out if with_aux else (out, None)
+            return scale_loss(loss, state.loss_scale), aux
 
-        loss, grads = jax.value_and_grad(scaled_loss)(state.params)
+        (loss, aux), grads = jax.value_and_grad(scaled_loss, has_aux=True)(
+            state.params
+        )
         grads, finite = unscale_grads(grads, state.loss_scale)
 
         new_state = state.apply_gradients(grads)
@@ -84,7 +94,7 @@ def make_train_step(
                 apply_fn=state.apply_fn,
                 tx=state.tx,
             )
-        return new_state, loss
+        return new_state, ((loss, aux) if with_aux else loss)
 
     if not jit:
         return step
@@ -104,7 +114,9 @@ def make_multi_step(step_fn: Callable, *, donate_state: bool = True):
 
     ``*rest`` (e.g. the dropout rng of the sparse step) is closed over
     per-chunk; steps stay distinct because the step folds the rng with the
-    step counter.
+    step counter.  ``with_aux`` steps are NOT accepted here — their chunked
+    composition (metric folding in the scan carry) lives in the trainer's
+    ``_wrap_auc_multi_step``.
     """
 
     def multi(state, stack, *rest):
@@ -121,6 +133,11 @@ def make_multi_step(step_fn: Callable, *, donate_state: bool = True):
 def _default_loss(params, apply_fn, batch):
     logits = apply_fn({"params": params}, batch)
     return bce_with_logits_loss(logits, batch["label"])
+
+
+def _default_loss_aux(params, apply_fn, batch):
+    logits = apply_fn({"params": params}, batch)
+    return bce_with_logits_loss(logits, batch["label"]), logits
 
 
 def make_eval_step(forward: Callable | None = None, *, mesh: Mesh | None = None):

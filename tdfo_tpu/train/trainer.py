@@ -140,6 +140,44 @@ def _make_ctr_eval_accum(logits_fn: Callable):
     return accum
 
 
+def _wrap_auc_step(inner, *, donate_state: bool = True):
+    """Fuse the train-side streaming-AUC fold INTO the step's single jitted
+    program: ``(state, batch, acc) -> (state, loss, acc)``.
+
+    One global program per step matters beyond speed: in multi-process runs a
+    SEPARATE jitted fold interleaved with the loop's eager loss arithmetic
+    deadlocked the cross-process dispatch rendezvous (two global programs
+    racing for the mesh in different orders on different hosts).  ``inner``
+    is an unjitted ``with_aux`` step returning ``(state, (loss, logits))``.
+    """
+
+    def step(state, batch, acc: AUC):
+        state, (loss, logits) = inner(state, batch)
+        acc = acc.update(batch["label"].astype(jnp.float32),
+                         jax.nn.sigmoid(logits))
+        return state, loss, acc
+
+    return jax.jit(step, donate_argnums=(0,) if donate_state else ())
+
+
+def _wrap_auc_multi_step(inner, *, donate_state: bool = True):
+    """steps_per_execution twin of :func:`_wrap_auc_step`: scan the unjitted
+    step over a stacked chunk, folding AUC in the scan carry."""
+
+    def multi(state, stack, acc: AUC):
+        def body(carry, batch):
+            st, a = carry
+            st, (loss, logits) = inner(st, batch)
+            a = a.update(batch["label"].astype(jnp.float32),
+                         jax.nn.sigmoid(logits))
+            return (st, a), loss
+
+        (state, acc), losses = jax.lax.scan(body, (state, acc), stack)
+        return state, losses.mean(), acc
+
+    return jax.jit(multi, donate_argnums=(0,) if donate_state else ())
+
+
 def _commit_replicated(state, mesh):
     """Pin every uncommitted leaf of a state pytree to the mesh, replicated.
 
@@ -190,6 +228,19 @@ class Trainer:
             self._build_bert4rec()
         else:
             raise ValueError(f"unknown model {cfg.model!r}")
+        # model.tabulate-equivalent observability (jax-flax/models.py:154-155)
+        if jax.process_index() == 0:
+            from tdfo_tpu.utils.summary import param_summary
+
+            if hasattr(self.state, "dense_params"):  # sparse/DMP regime
+                summary = param_summary(
+                    self.state.dense_params, tables=self.state.tables,
+                    coll=self.coll, title=f"{cfg.model} parameters",
+                )
+            else:
+                summary = param_summary(self.state.params,
+                                        title=f"{cfg.model} parameters")
+            print(summary, flush=True)
 
     def _set_ctr_streams(self) -> None:
         cfg = self.config
@@ -256,12 +307,12 @@ class Trainer:
             )
         else:
             self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
+        inner = make_train_step(mesh=self.mesh, jit=False, with_aux=True)
         if cfg.steps_per_execution > 1:
-            self.train_step = make_multi_step(
-                make_train_step(mesh=self.mesh, jit=False)
-            )
+            self.train_step = _wrap_auc_multi_step(inner)
         else:
-            self.train_step = make_train_step(mesh=self.mesh)
+            self.train_step = _wrap_auc_step(inner)
+        self._train_auc_enabled = True
         self.eval_step = make_eval_step(mesh=self.mesh)
         self._eval_schema = _ctr_eval_schema()
         self.eval_accum = _make_ctr_eval_accum(
@@ -326,19 +377,15 @@ class Trainer:
                 "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
             ),
         ), self.mesh)
+        inner = make_sparse_train_step(
+            coll, ctr_sparse_forward(backbone, with_logits=True),
+            mode=cfg.lookup_mode, jit=False, with_aux=True,
+        )
         if cfg.steps_per_execution > 1:
-            self.train_step = make_multi_step(
-                make_sparse_train_step(
-                    coll, ctr_sparse_forward(backbone),
-                    mode=cfg.lookup_mode, jit=False,
-                ),
-                donate_state=False,
-            )
+            self.train_step = _wrap_auc_multi_step(inner, donate_state=False)
         else:
-            self.train_step = make_sparse_train_step(
-                coll, ctr_sparse_forward(backbone),
-                mode=cfg.lookup_mode, donate=False,
-            )
+            self.train_step = _wrap_auc_step(inner, donate_state=False)
+        self._train_auc_enabled = True
         self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
         self._eval_schema = _ctr_eval_schema()
         features, mode = list(coll.features()), cfg.lookup_mode
@@ -424,6 +471,7 @@ class Trainer:
                 self.coll, bert4rec_sparse_forward(self.backbone),
                 mode=cfg.lookup_mode, donate=False, batch_transform=transform,
             )
+        self._train_auc_enabled = False  # AUC is a binary-CTR metric
         self._dropout_rng = jax.random.key(cfg.seed + 1)
         self._stream_cls = ParquetStream  # seq ETL writes parquet only
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
@@ -572,6 +620,10 @@ class Trainer:
         n_steps = 0
         next_log = cfg.log_every_n_steps
         profiled = cfg.profile and epoch == 0 and jax.process_index() == 0
+        # train-side streaming AUC on this epoch's predictions, folded ON
+        # DEVICE from the step's aux logits — no second forward pass
+        # (jax-flax/train_dp.py:190,219-220 parity)
+        train_auc = AUC.empty() if self._train_auc_enabled else None
         for batch, k in self._train_batches(epoch):
             if profiled is True and n_steps >= 10:
                 jax.profiler.start_trace(str(Path(cfg.checkpoint_dir or ".") / "profile"))
@@ -579,7 +631,9 @@ class Trainer:
             if cfg.model == "bert4rec":
                 self.state, loss = self.train_step(self.state, batch, self._dropout_rng)
             else:
-                self.state, loss = self.train_step(self.state, batch)
+                self.state, loss, train_auc = self.train_step(
+                    self.state, batch, train_auc
+                )
             n_steps += k
             loss_k = loss * k  # chunk mean -> chunk sum (k=1: identity)
             loss_sum = loss_k if loss_sum is None else loss_sum + loss_k
@@ -598,10 +652,14 @@ class Trainer:
             jax.profiler.stop_trace()
         dt = time.perf_counter() - t0
         avg = float(loss_sum) / n_steps if n_steps else 0.0
+        extra: dict[str, float] = {}
+        if train_auc is not None and n_steps:
+            extra["train_auc"] = float(train_auc.result())
         self.logger.log(
             epoch=epoch, train_loss_epoch=avg, steps=n_steps,
             examples_per_sec=n_steps * cfg.per_device_train_batch_size
             * self.mesh.shape["data"] / max(dt, 1e-9),
+            **extra,
         )
         return avg
 
@@ -613,7 +671,8 @@ class Trainer:
                 return self._evaluate_bert4rec(epoch)
             return self._evaluate_twotower(epoch)
 
-    def _eval_batches(self, rename: Callable[[dict], dict] | None = None) -> Iterator[dict]:
+    def _eval_batches(self, rename: Callable[[dict], dict] | None = None,
+                      pattern: str | None = None) -> Iterator[dict]:
         """Padded, budgeted, mesh-sharded eval batches.
 
         Every host yields exactly ``max_batches_per_host()`` batches — short
@@ -626,7 +685,7 @@ class Trainer:
         extra columns its files carry.  Each batch has a ``_weight`` row
         mask.
         """
-        stream = self._stream(self._eval_pattern, train=False)
+        stream = self._stream(pattern or self._eval_pattern, train=False)
         budget = stream.max_batches_per_host()
         bsz = stream.batch_size
         schema = self._eval_schema
@@ -640,10 +699,26 @@ class Trainer:
             n = 0
             for raw in stream:
                 if rename is not None:
-                    raw = rename(raw)
+                    try:
+                        raw = rename(raw)
+                    except KeyError as e:
+                        raise ValueError(
+                            f"eval shard is missing column {e} "
+                            f"(has {sorted(raw)}); it was likely written by "
+                            "an older or mismatched preprocessing run — "
+                            "re-run preprocessing for this data_dir"
+                        ) from None
                 # cast to the schema dtypes: loaders differ (tfrecord decodes
                 # ints as int64, parquet as int32/int8) and real batches must
                 # be aval-identical to synthesized templates on EVERY host
+                missing = schema.keys() - raw.keys()
+                if missing:
+                    raise ValueError(
+                        f"eval shard is missing columns {sorted(missing)} "
+                        f"(has {sorted(raw)}); it was likely written by an "
+                        "older or mismatched preprocessing run — re-run "
+                        "preprocessing for this data_dir"
+                    )
                 real = {
                     k: np.asarray(raw[k]).astype(dtype, copy=False)
                     for k, (dtype, _) in schema.items()
@@ -681,18 +756,42 @@ class Trainer:
 
     _METRIC_KS = (10, 20, 50)
 
-    def _evaluate_bert4rec(self, epoch: int) -> dict[str, float]:
+    def _evaluate_bert4rec(self, epoch: int, pattern: str | None = None,
+                           prefix: str = "") -> dict[str, float]:
         acc: dict[str, jax.Array] = {"w_sum": jnp.zeros(())}
         for k in self._METRIC_KS:
             acc[f"Recall@{k}"] = jnp.zeros(())
             acc[f"NDCG@{k}"] = jnp.zeros(())
         rename = lambda raw: {"seqs": raw["eval_seqs"], "cands": raw["candidate_items"]}
-        for batch in self._eval_batches(rename):
+        for batch in self._eval_batches(rename, pattern=pattern):
             acc = self.eval_accum(self.state, batch, acc)
         w = max(float(acc.pop("w_sum")), 1.0)
-        metrics = {k: float(v) / w for k, v in acc.items()}
+        metrics = {prefix + k: float(v) / w for k, v in acc.items()}
         self.logger.log(epoch=epoch, **metrics)
         return metrics
+
+    def evaluate_test(self) -> dict[str, float]:
+        """Final held-out TEST evaluation (bert4rec leave-last-one).
+
+        Beats the reference's dead code: ``train_val_test`` never tests
+        despite its name (``torchrec/train.py:147-177``).  Returns {} when
+        the data dir has no test shards (older preprocessing runs) or the
+        knob is disabled.  Runs the same lockstep-budgeted eval machinery,
+        so multi-host meshes stay in step.
+        """
+        cfg = self.config
+        if cfg.model != "bert4rec" or not cfg.test_data:
+            return {}
+        pattern = str(Path("parquet_bert4rec") / cfg.test_data)
+        try:
+            resolve_files(cfg.data_dir, pattern)
+        except FileNotFoundError:
+            self.logger.log(test_split="absent (re-run preprocess-seq to write it)")
+            return {}
+        with self._jit_ctx():
+            return self._evaluate_bert4rec(
+                epoch=self.config.n_epochs, pattern=pattern, prefix="test_"
+            )
 
     # ------------------------------------------------------------------ fit
 
@@ -716,5 +815,7 @@ class Trainer:
                 or epoch == cfg.n_epochs - 1
             ):
                 self._ckpt.save(epoch, self.state)
+        # final held-out test evaluation (bert4rec; no-op elsewhere)
+        metrics.update(self.evaluate_test())
         self.logger.close()
         return metrics

@@ -1,0 +1,66 @@
+"""Parameter summaries — ``model.tabulate`` equivalent.
+
+The reference prints a layer table via ``flax`` (``_visualize_model_layers``,
+``jax-flax/models.py:154-155``).  Here the same capability works for ANY
+param pytree (flax params, sparse-regime dense params, embedding tables —
+including fat-row storage, where the array carries optimizer moments and the
+true parameter count comes from the table spec).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+__all__ = ["param_summary", "tabulate_model"]
+
+
+def _rows_from_tree(params: Any, prefix: str = "") -> list[tuple[str, tuple, str, int]]:
+    rows = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = prefix + "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                                 for k in path)
+        rows.append((name, tuple(leaf.shape), str(leaf.dtype), int(np.prod(leaf.shape) or 1)))
+    return rows
+
+
+def param_summary(
+    params: Any,
+    tables: Mapping[str, jax.Array] | None = None,
+    coll=None,
+    title: str = "model parameters",
+) -> str:
+    """Render a parameter table (name, shape, dtype, count) plus totals.
+
+    ``tables``/``coll``: the sparse regime's embedding arrays and their
+    ``ShardedEmbeddingCollection`` — fat-row arrays ([V, T, 128] holding
+    table|mu|nu) are reported with their TRUE parameter count (vocab x dim
+    from the spec), with the storage shape shown alongside.
+    """
+    rows = _rows_from_tree(params)
+    if tables is not None:
+        for name, arr in sorted(tables.items()):
+            if coll is not None and arr.ndim == 3:  # fat storage
+                d = coll.array_embedding_dim(name)
+                count = arr.shape[0] * d
+                rows.append((f"tables/{name} (fat {tuple(arr.shape)} incl. moments)",
+                             (arr.shape[0], d), str(arr.dtype), count))
+            else:
+                rows.append((f"tables/{name}", tuple(arr.shape), str(arr.dtype),
+                             int(np.prod(arr.shape) or 1)))
+    w = max((len(r[0]) for r in rows), default=10) + 2
+    lines = [title, "-" * len(title)]
+    for name, shape, dtype, count in rows:
+        lines.append(f"{name:<{w}} {str(shape):<20} {dtype:<10} {count:>14,}")
+    total = sum(r[3] for r in rows)
+    lines.append("-" * len(title))
+    lines.append(f"{'total':<{w}} {'':<20} {'':<10} {total:>14,}")
+    return "\n".join(lines)
+
+
+def tabulate_model(model, *init_args, **init_kwargs) -> str:
+    """flax ``Module.tabulate`` passthrough (jax-flax/models.py:154-155
+    parity) for callers holding a flax module + dummy inputs."""
+    return model.tabulate(jax.random.key(0), *init_args, **init_kwargs)

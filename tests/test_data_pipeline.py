@@ -138,6 +138,34 @@ class TestSeqEtl:
         # last position is always the MASK token; left-padded
         assert (seqs[:, -1] == mask_id).all()
 
+    def test_test_split_candidates(self, data_dir, seq_stats):
+        """The TEST split (reference computes it and never consumes it,
+        torchrec/train.py:147-177) is written with eval-compatible columns,
+        includes the eval item as known history, and never leaks the test
+        item into its negatives."""
+        files = resolve_files(data_dir, "parquet_bert4rec/test_part_*.parquet")
+        tbl = load_parquet_table(files)
+        cands = tbl["candidate_items"]
+        assert cands.shape[1] == 1 + EVAL_NEG_NUM
+        for row in cands:
+            assert row[0] not in row[1:]
+            assert len(np.unique(row[1:])) == EVAL_NEG_NUM
+        seqs = tbl["eval_seqs"]
+        mask_id = seq_stats["n_items"] + 1
+        assert (seqs[:, -1] == mask_id).all()
+
+        # cross-check vs eval shards: test input history = eval history + the
+        # eval positive (leave-last-one protocol), per user
+        efiles = resolve_files(data_dir, "parquet_bert4rec/eval_part_*.parquet")
+        etbl = load_parquet_table(efiles)
+        by_user = {u: (s, c) for u, s, c in
+                   zip(etbl["user_id"], etbl["eval_seqs"], etbl["candidate_items"])}
+        for u, s, c in zip(tbl["user_id"], seqs, cands):
+            es, ec = by_user[u]
+            eval_pos = ec[0]
+            assert s[-2] == eval_pos  # last known item before MASK
+            assert eval_pos not in c[1:]  # eval item is a positive: excluded
+
 
 class TestParquetStream:
     def test_exactly_once_per_epoch(self, data_dir, ctr_size_map):

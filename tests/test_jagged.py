@@ -71,3 +71,14 @@ def test_jagged_to_dense_per_host_segmented_offsets():
     vg, lg = pack_rows(rows, 4 * t)
     want = np.asarray(jagged_to_dense(jnp.asarray(vg), jnp.asarray(lg), t, 0))
     np.testing.assert_array_equal(got, want)
+
+
+def test_per_host_divisibility_rejected():
+    import pytest
+
+    from tdfo_tpu.data.jagged import jagged_to_dense_per_host
+
+    values = jnp.zeros((10,), jnp.int32)  # 10 % 3 != 0
+    lengths = jnp.zeros((6,), jnp.int32)
+    with pytest.raises(ValueError, match="divide"):
+        jagged_to_dense_per_host(values, lengths, 4, 0, n_hosts=3)

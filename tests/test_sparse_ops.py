@@ -152,3 +152,22 @@ def test_dedupe_all_padding():
     table = jnp.zeros((5, 2))
     out = sparse_sgd(table, uids, g, valid, lr=1.0)
     assert np.all(np.asarray(out) == 0)
+
+
+def test_dedupe_capacity_guard():
+    """Undersized capacity is a TRACE-TIME error unless vocab proves it safe
+    (VERDICT r3 weak #5: the old CPU-only runtime print doesn't exist on the
+    production backend)."""
+    import pytest
+
+    ids = jnp.arange(16, dtype=jnp.int32)
+    g = jnp.ones((16, 2))
+    with pytest.raises(ValueError, match="capacity"):
+        dedupe_grads(ids, g, capacity=8)
+    with pytest.raises(ValueError, match="capacity"):
+        jax.jit(lambda i, gg: dedupe_grads(i, gg, capacity=8))(ids, g)
+    # vocab <= capacity licenses the small capacity, and the result is exact
+    small = ids % 8
+    uids, gg, valid = dedupe_grads(small, g, capacity=8, vocab=8)
+    assert bool(valid.all())
+    np.testing.assert_allclose(np.asarray(gg), 2.0 * np.ones((8, 2)))
