@@ -113,7 +113,11 @@ class Config:
     # drop ids past a bucket's capacity (zero vectors) under extreme skew.
     a2a_capacity_factor: float = 0.0
     # attention core for sequence models: "full" (T x T), "ring"
-    # (sequence-parallel over the seq mesh axis), "flash" (Pallas O(T) kernel)
+    # (sequence-parallel over the seq mesh axis; XLA blockwise innards —
+    # the fastest long-T path measured on v5e), "ring_flash" (ring with the
+    # Pallas flash kernels inside each ring step; ~2.4x slower than "ring"
+    # at dh=64 on v5e — see bench_kernels.bench_ring_flash), "flash"
+    # (single-device Pallas O(T) kernel)
     attn: str = "full"
     # ring attention only: chunk each ring step's local attention to
     # O(Tq x ring_block_k) logits with a rematerialised backward (0 = one
@@ -184,7 +188,7 @@ class Config:
                 "model=\"bert4rec\" supports write_format=\"parquet\" only "
                 "(sequence columns are list-valued)"
             )
-        if self.attn not in ("full", "ring", "flash"):
+        if self.attn not in ("full", "ring", "ring_flash", "flash"):
             raise ValueError(f"unknown attn: {self.attn!r}")
         if self.ring_block_k < 0:
             raise ValueError("ring_block_k must be >= 0 (0 = unchunked)")

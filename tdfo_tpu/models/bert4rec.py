@@ -156,6 +156,7 @@ def make_sharded_bert4rec(
     fused_threshold: int | None = 16384,
     a2a_capacity_factor: float | None = None,
     ring_block_k: int | None = None,
+    tp_heads: bool = False,
 ):
     """The DMP-equivalent wiring (``torchrec/train.py:235-254``): item table in
     a ShardedEmbeddingCollection (sharded over ``model``), dense transformer
@@ -188,13 +189,22 @@ def make_sharded_bert4rec(
     )
     k_table, k_dense = jax.random.split(rng)
     tables = coll.init(k_table)
-    if attn == "ring":
+    if attn in ("ring", "ring_flash"):
         # sequence parallelism: attention shards T over the "seq" mesh axis
         # (ring K/V rotation over ICI) — long-context capability beyond the
-        # reference's full T×T attention.
+        # reference's full T×T attention.  ``tp_heads`` composes it with
+        # Megatron attention TP (heads over the "model" axis — pair with
+        # megatron_tp_rule(n_heads=...) on the dense params); the batch stays
+        # sharded over "data" rather than gathering per layer.
+        from tdfo_tpu.core.mesh import DATA_AXIS, MODEL_AXIS
         from tdfo_tpu.parallel.ring_attention import make_ring_attn_fn
 
-        attn_fn = make_ring_attn_fn(mesh, block_k=ring_block_k)
+        attn_fn = make_ring_attn_fn(
+            mesh, block_k=ring_block_k,
+            head_axis=MODEL_AXIS if tp_heads else None,
+            batch_axis=DATA_AXIS,
+            impl="flash" if attn == "ring_flash" else "xla",
+        )
     elif attn == "flash":
         # single-device long-context path: Pallas blockwise online-softmax
         # kernel, O(T) memory (tdfo_tpu/ops/pallas_kernels.py)

@@ -58,8 +58,15 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(f"dim {d} not divisible by {self.n_heads} heads")
         dh = d // self.n_heads
         qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(x)  # [B, T, 3D]
-        qkv = qkv.reshape(b, t, 3, self.n_heads, dh)
-        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))  # [B,H,T,Dh]
+        # feature layout (head, qkv, dh) — NOT (qkv, head, dh): contiguous
+        # chunks of the fused output features are then whole heads, so a
+        # Megatron column split of the qkv kernel (megatron_tp_rule) shards
+        # cleanly onto the head axis under GSPMD with no resharding.
+        # COMPAT: this reinterprets the fused kernel's columns — checkpoints
+        # saved under the pre-round-4 (qkv, head, dh) layout load without
+        # error but scramble q/k/v; retrain or permute the kernel on load.
+        qkv = qkv.reshape(b, t, self.n_heads, 3, dh)
+        q, k, v = (jnp.swapaxes(qkv[:, :, :, i, :], 1, 2) for i in range(3))  # [B,H,T,Dh]
         out = self.attn_fn(q, k, v, mask)  # [B, H, T, Dh]
         out = jnp.moveaxis(out, 1, 2).reshape(b, t, d)
         out = nn.Dropout(self.dropout)(out, deterministic=deterministic)

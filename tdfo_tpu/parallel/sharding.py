@@ -90,32 +90,54 @@ def min_size_partitioner_rule(
     return rule
 
 
-def megatron_tp_rule(mesh: Mesh, axis: str = MODEL_AXIS) -> PlanRule:
+def megatron_tp_rule(mesh: Mesh, axis: str = MODEL_AXIS,
+                     n_heads: int | None = None) -> PlanRule:
     """Tensor parallelism for transformer dense layers (a capability the
     reference lacks entirely — SURVEY.md §2.3 lists TP as absent).
 
-    The Megatron split expressed as sharding specs (GSPMD inserts the
-    collectives): feed-forward up-projections and the vocab output projection
-    shard their OUTPUT features over the model axis (column parallel, biases
-    shard along), the feed-forward down-projection shards its INPUT features
-    (row parallel, GSPMD psums the partial products).  On Bert4Rec the vocab
-    projection [D, V] is both the FLOPs peak and the largest dense parameter,
-    so this is where TP pays.
+    The full Megatron split expressed as sharding specs (GSPMD inserts the
+    collectives):
+
+      * column parallel (output features over ``axis``, biases along):
+        feed-forward up-projection ``fc1``, vocab ``out_proj`` (on Bert4Rec
+        the FLOPs peak and largest dense param), and the fused attention
+        ``attn/qkv`` — whose feature layout is (head, qkv, dh)
+        (``models/transformer.py``), so the column split is a HEAD split and
+        the whole attention core runs head-parallel;
+      * row parallel (input features over ``axis``, GSPMD psums the partial
+        products, bias replicated): feed-forward ``fc2`` and the attention
+        output projection ``attn/out``.
+
+    ``n_heads`` gates the attention split: head-parallelism is only clean
+    when ``n_heads %% axis_size == 0`` — a bad mesh raises at plan time
+    rather than silently resharding mid-layer every step.  With ``n_heads``
+    unknown (None) attention params stay replicated (FFN/vocab still shard).
     """
-    col = re.compile(r"(fc1|out_proj)/(kernel|bias)$")
-    row = re.compile(r"fc2/kernel$")
+    col = re.compile(r"(fc1|out_proj|attn/qkv)/(kernel|bias)$")
+    row = re.compile(r"(fc2|attn/out)/kernel$")
+    attn_pat = re.compile(r"attn/(qkv|out)/")
+    size = mesh.shape[axis]
 
     def rule(path: str, leaf) -> P | None:
         if not hasattr(leaf, "ndim"):
             return None
+        if attn_pat.search(path):
+            if n_heads is None:
+                return None  # cannot prove head alignment: leave replicated
+            if n_heads % size:
+                raise ValueError(
+                    f"tensor parallelism needs n_heads ({n_heads}) divisible "
+                    f"by the {axis!r} mesh axis ({size}); pick a compatible "
+                    "mesh or head count"
+                )
         m = col.search(path)
         if m:
-            if leaf.ndim == 2 and leaf.shape[1] % mesh.shape[axis] == 0:
+            if leaf.ndim == 2 and leaf.shape[1] % size == 0:
                 return P(None, axis)
-            if leaf.ndim == 1 and leaf.shape[0] % mesh.shape[axis] == 0:
+            if leaf.ndim == 1 and leaf.shape[0] % size == 0:
                 return P(axis)
             return None
-        if row.search(path) and leaf.ndim == 2 and leaf.shape[0] % mesh.shape[axis] == 0:
+        if row.search(path) and leaf.ndim == 2 and leaf.shape[0] % size == 0:
             return P(axis, None)
         return None
 

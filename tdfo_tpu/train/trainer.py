@@ -421,12 +421,24 @@ class Trainer:
             fused_threshold=cfg.fused_table_threshold,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
             ring_block_k=cfg.ring_block_k or None,
+            tp_heads=cfg.tensor_parallel and cfg.attn in ("ring", "ring_flash"),
         )
         if cfg.tensor_parallel:
             from tdfo_tpu.parallel.sharding import megatron_tp_rule, shard_state
 
-            # optax moments mirror the params and inherit these shardings
-            dense = shard_state(dense, self.mesh, megatron_tp_rule(self.mesh))
+            # optax moments mirror the params and inherit these shardings;
+            # n_heads licenses the attention (head-parallel) split and
+            # rejects head-indivisible meshes at plan time.  attn="flash"
+            # keeps attention replicated (n_heads=None): the Pallas kernel
+            # has no GSPMD partitioning rule, so head-sharded params would
+            # all-gather inside every layer.
+            dense = shard_state(
+                dense, self.mesh,
+                megatron_tp_rule(
+                    self.mesh,
+                    n_heads=cfg.n_heads if cfg.attn != "flash" else None,
+                ),
+            )
         self.state = _commit_replicated(SparseTrainState.create(
             dense_params=dense,
             tx=optax.adamw(cfg.learning_rate, weight_decay=cfg.weight_decay),

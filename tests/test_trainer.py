@@ -268,11 +268,37 @@ def test_tensor_parallel_bert4rec(prepared_dir, tmp_path):
     assert any("out_proj/kernel" in p for p in sharded), sharded
     assert any("fc1/kernel" in p for p in sharded)
     assert any("fc2/kernel" in p for p in sharded)
+    # full Megatron: attention QKV column-parallel, out-proj row-parallel
+    assert any("attn/qkv/kernel" in p for p in sharded), sharded
+    assert any("attn/out/kernel" in p for p in sharded), sharded
 
     m_tp = tr_tp.fit()
     m_rep = Trainer(read_configs(None, **common)).fit()
     for k in m_rep:
         assert np.isclose(m_tp[k], m_rep[k], rtol=1e-3, atol=1e-5), (k, m_tp[k], m_rep[k])
+
+
+def test_megatron_head_divisibility_guard():
+    """A mesh whose model axis does not divide n_heads must be rejected at
+    plan time, not silently resharded mid-layer (VERDICT r3 next #3)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+    from tdfo_tpu.parallel.sharding import make_sharding_plan, megatron_tp_rule
+
+    mesh = make_mesh(MeshSpec(data=4, model=2, seq=1))
+    tree = {"block_0": {"attn": {"qkv": {"kernel": jnp.zeros((16, 48))}}}}
+    with pytest.raises(ValueError, match="n_heads"):
+        make_sharding_plan(tree, mesh, megatron_tp_rule(mesh, n_heads=3))
+    # divisible heads shard; unknown heads leave attention replicated
+    plan = make_sharding_plan(tree, mesh, megatron_tp_rule(mesh, n_heads=2))
+    spec = plan["block_0"]["attn"]["qkv"]["kernel"].spec
+    assert any(ax is not None for ax in spec), spec
+    plan_unknown = make_sharding_plan(tree, mesh, megatron_tp_rule(mesh))
+    assert all(ax is None for ax in plan_unknown["block_0"]["attn"]["qkv"]["kernel"].spec)
 
 
 def test_train_auc_matches_exact(prepared_dir, tmp_path):
