@@ -128,6 +128,13 @@ class Config:
     # and biggest dense param).  A sharding-spec change only; GSPMD inserts
     # the collectives.  Beyond-reference capability (SURVEY.md §2.3: absent).
     tensor_parallel: bool = False
+    # in-backward sparse optimizer for embedding tables in the DMP regime
+    # (fbgemm EmbOptimType parity: the reference picks ADAM on GPU and SGD on
+    # CPU, torchrec/train.py:187-195).  "rowwise_adagrad" stores ONE f32
+    # accumulator per row (fbgemm EXACT_ROWWISE_ADAGRAD, the >=1e9-row
+    # configuration); non-adam kinds disable fat-row fused storage (its
+    # packed moments are adam-specific).
+    sparse_optimizer: str = "adam"
     # vocab size above which DMP-regime tables use fused fat-row storage
     # (ops/pallas_kernels.fat_layout + the in-place DMA Adam kernel); smaller
     # tables take the one-hot MXU update.  The kernel choice itself is
@@ -194,10 +201,21 @@ class Config:
             raise ValueError("ring_block_k must be >= 0 (0 = unchunked)")
         if self.ring_block_k and self.attn != "ring":
             raise ValueError("ring_block_k requires attn = \"ring\"")
+        if self.sparse_optimizer not in ("adam", "sgd", "adagrad",
+                                         "rowwise_adagrad"):
+            raise ValueError(f"unknown sparse_optimizer: {self.sparse_optimizer!r}")
         if self.steps_per_execution < 1:
             raise ValueError("steps_per_execution must be >= 1")
         if not self.streaming and self.write_format != "parquet":
             raise ValueError("streaming=false (map-style) requires parquet data")
+
+    @property
+    def effective_fused_threshold(self) -> int | None:
+        """fused fat-row storage packs adam moments per row — any other
+        sparse optimizer kind disables it (one source of truth for both
+        model-family builders)."""
+        return (self.fused_table_threshold
+                if self.sparse_optimizer == "adam" else None)
 
     @property
     def global_train_batch_size(self) -> int:

@@ -9,7 +9,8 @@ the batch during the backward pass; the equivalent here is:
      activation), never materialising a dense [V, D] gradient;
   2. :func:`dedupe_grads` merges duplicate ids with a segment-sum;
   3. a sparse update (:func:`sparse_sgd` / :func:`sparse_adam` /
-     :func:`sparse_adagrad`) gathers the touched optimizer-state rows,
+     :func:`sparse_adagrad` / :func:`sparse_rowwise_adagrad`) gathers the
+     touched optimizer-state rows,
      updates them, and scatters back — O(B*D) work and memory traffic per
      step instead of O(V*D), which is what makes >=1B-row tables feasible
      (SURVEY.md §7 hard part #2).
@@ -32,6 +33,7 @@ __all__ = [
     "sparse_sgd",
     "sparse_adam",
     "sparse_adagrad",
+    "sparse_rowwise_adagrad",
     "dense_lazy_adam",
     "fat_adam_update",
     "SparseOptimizer",
@@ -125,6 +127,26 @@ def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
         _masked_scatter_rows(mu, uids, mu_n, valid),
         _masked_scatter_rows(nu, uids, nu_n, valid),
         new_count,
+    )
+
+
+def sparse_rowwise_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
+                           weight_decay=0.0):
+    """fbgemm EXACT_ROWWISE_ADAGRAD parity: ONE f32 accumulator PER ROW
+    (mean of squared grads), not per element — optimizer state is V x 4
+    bytes instead of V x D x 8, which is what lets a v5e hold a 4x10^8-row
+    dim-8 table WITH adaptive-optimizer semantics (fbgemm's default choice
+    for huge tables; ``torchrec/train.py:191`` uses ADAM but fbgemm's TBE
+    rowwise variant is the >=1B-row configuration).
+    """
+    rows = table[uids]
+    acc_r = accum[uids]  # [U]
+    g = g.astype(jnp.float32) + weight_decay * rows
+    acc_n = acc_r + jnp.mean(g * g, axis=-1)
+    delta = lr * g / (jnp.sqrt(acc_n)[:, None] + eps)
+    return (
+        _masked_scatter_rows(table, uids, rows - delta.astype(rows.dtype), valid),
+        _masked_scatter_rows(accum, uids, acc_n, valid),
     )
 
 
@@ -245,7 +267,7 @@ class SparseOptimizer:
         portable XLA formulation).
     """
 
-    kind: str  # "sgd" | "adam" | "adagrad"
+    kind: str  # "sgd" | "adam" | "adagrad" | "rowwise_adagrad"
     lr: float
     weight_decay: float = 0.0
     b1: float = 0.9
@@ -262,6 +284,9 @@ class SparseOptimizer:
             return ()
         if self.kind == "adagrad":
             return (jnp.zeros_like(table, dtype=jnp.float32),)
+        if self.kind == "rowwise_adagrad":
+            # ONE f32 cell per row: the state layout that scales to 1e9 rows
+            return (jnp.zeros((table.shape[0],), jnp.float32),)
         if self.kind == "adam":
             return (
                 jnp.zeros_like(table, dtype=jnp.float32),
@@ -298,6 +323,12 @@ class SparseOptimizer:
             (accum,) = slots
             table, accum = sparse_adagrad(table, accum, uids, g, valid, lr=self.lr,
                                           eps=self.eps, weight_decay=self.weight_decay)
+            return table, (accum,)
+        if self.kind == "rowwise_adagrad":
+            (accum,) = slots
+            table, accum = sparse_rowwise_adagrad(
+                table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
+                weight_decay=self.weight_decay)
             return table, (accum,)
         if self.kind == "adam":
             mu, nu, count = slots

@@ -347,7 +347,7 @@ class Trainer:
         sharding = cfg.embedding_sharding if cfg.model_parallel else "replicated"
         coll = ShardedEmbeddingCollection(
             ctr_embedding_specs(cfg.size_map, cfg.embed_dim, sharding,
-                                fused_threshold=cfg.fused_table_threshold),
+                                fused_threshold=cfg.effective_fused_threshold),
             mesh=self.mesh,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
         )
@@ -374,7 +374,8 @@ class Trainer:
             # fused_table_threshold is a storage-layout choice — one knob
             # must not drag the other
             sparse_opt=sparse_optimizer(
-                "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
+                cfg.sparse_optimizer, lr=cfg.learning_rate,
+                weight_decay=cfg.weight_decay,
             ),
         ), self.mesh)
         inner = make_sparse_train_step(
@@ -418,7 +419,7 @@ class Trainer:
         self.coll, tables, self.backbone, dense = make_sharded_bert4rec(
             jax.random.key(cfg.seed), self.model_cfg, self.mesh,
             sharding=sharding, attn=cfg.attn,
-            fused_threshold=cfg.fused_table_threshold,
+            fused_threshold=cfg.effective_fused_threshold,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
             ring_block_k=cfg.ring_block_k or None,
             tp_heads=cfg.tensor_parallel and cfg.attn in ("ring", "ring_flash"),
@@ -448,7 +449,8 @@ class Trainer:
             # fused_table_threshold is a storage-layout choice — one knob
             # must not drag the other
             sparse_opt=sparse_optimizer(
-                "adam", lr=cfg.learning_rate, weight_decay=cfg.weight_decay,
+                cfg.sparse_optimizer, lr=cfg.learning_rate,
+                weight_decay=cfg.weight_decay,
             ),
         ), self.mesh)
         # jagged mode: batches arrive as (values, lengths) pairs packed per

@@ -114,3 +114,40 @@ def test_num_workers_preserves_order(ctr_data):
     for a, b in zip(base, threaded):
         for k in a:
             np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_sparse_optimizer_knob(tmp_path):
+    """sparse_optimizer="rowwise_adagrad" trains the DMP regime with per-row
+    accumulator state and disables adam-specific fat storage."""
+    import jax
+    import numpy as np
+
+    from tdfo_tpu.core.config import read_configs
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.train.trainer import Trainer
+
+    d = tmp_path / "gr"
+    write_synthetic_goodreads(d, n_users=60, n_books=80,
+                              interactions_per_user=(12, 24), seed=9)
+    ctr = run_ctr_preprocessing(d)
+    cfg = read_configs(
+        None, data_dir=d, model="twotower", model_parallel=True,
+        sparse_optimizer="rowwise_adagrad", fused_table_threshold=8,
+        n_epochs=1, learning_rate=3e-3, embed_dim=8,
+        per_device_train_batch_size=16, per_device_eval_batch_size=16,
+        shuffle_buffer_size=500, log_every_n_steps=1000, size_map=ctr,
+    )
+    tr = Trainer(cfg)
+    # fat storage disabled despite the tiny threshold (adam-only layout)
+    assert all(t.ndim == 2 for t in tr.state.tables.values())
+    # every slot is the per-row accumulator
+    for name, slot in tr.state.slots.items():
+        assert slot[0].shape == (tr.state.tables[name].shape[0],)
+    m = tr.fit()
+    assert 0.0 <= m["auc"] <= 1.0
+
+    import pytest
+
+    with pytest.raises(ValueError, match="sparse_optimizer"):
+        read_configs(None, sparse_optimizer="lion")

@@ -171,3 +171,37 @@ def test_dedupe_capacity_guard():
     uids, gg, valid = dedupe_grads(small, g, capacity=8, vocab=8)
     assert bool(valid.all())
     np.testing.assert_allclose(np.asarray(gg), 2.0 * np.ones((8, 2)))
+
+
+def test_rowwise_adagrad_semantics():
+    """fbgemm EXACT_ROWWISE_ADAGRAD: per-ROW accumulator of mean squared
+    grads; dedupe merges duplicates first; padding ids contribute nothing."""
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer("rowwise_adagrad", lr=0.5)
+    table = jnp.ones((6, 4), jnp.float32)
+    slots = opt.init(table)
+    assert slots[0].shape == (6,)  # one cell per row, not per element
+    ids = jnp.array([1, 3, 1, -1], jnp.int32)
+    g = jnp.stack([
+        jnp.full((4,), 1.0), jnp.full((4,), 2.0),
+        jnp.full((4,), 3.0), jnp.full((4,), 99.0),  # padding row: dropped
+    ])
+    new_table, (accum,) = opt.update(table, slots, ids, g)
+    # row 1: merged grad = 4.0 per element -> acc = mean(16) = 16
+    np.testing.assert_allclose(accum[1], 16.0)
+    np.testing.assert_allclose(accum[3], 4.0)
+    assert accum[0] == accum[2] == accum[4] == accum[5] == 0.0
+    np.testing.assert_allclose(
+        np.asarray(new_table[1]), 1.0 - 0.5 * 4.0 / (4.0 + 1e-10), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(new_table[3]), 1.0 - 0.5 * 2.0 / (2.0 + 1e-10), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_table[0]), 1.0)  # untouched
+
+    # second step accumulates (adaptive: same grad moves the row LESS)
+    t2, (acc2,) = opt.update(new_table, (accum,), jnp.array([1], jnp.int32),
+                             jnp.full((1, 4), 4.0))
+    np.testing.assert_allclose(acc2[1], 32.0)
+    step2 = np.asarray(new_table[1] - t2[1])
+    step1 = np.asarray(table[1] - new_table[1])
+    assert (step2 < step1).all()
