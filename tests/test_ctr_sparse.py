@@ -242,7 +242,15 @@ def test_fused_sparse_state_checkpoint_resume(ctr_data, tmp_path):
         checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every_n_epochs=1,
     )
     tr1 = Trainer(_trainer_cfg(d, size_map, n_epochs=1, **common))
-    assert tr1.state.tables["user_embed"].ndim == 3  # fat rows
+    # all 7 same-dim fused tables stack into ONE fat array (TBE parity):
+    # one dedupe + one in-place kernel launch per step for the whole group
+    (stack_name,) = [n for n in tr1.state.tables if n.startswith("__fatstack_")]
+    assert tr1.state.tables[stack_name].ndim == 3  # fat rows
+    # every FUSED table (vocab > threshold) lives in the stack; the tiny
+    # non-fused tables keep their own 2D arrays
+    assert all(t.ndim == 2 for n, t in tr1.state.tables.items()
+               if n != stack_name)
+    assert len(tr1.state.tables) < 7
     m1 = tr1.fit()
     tr2 = Trainer(_trainer_cfg(d, size_map, n_epochs=2, **common))
     assert tr2._ckpt.latest_step() == 0
