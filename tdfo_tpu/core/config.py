@@ -87,6 +87,13 @@ class Config:
     # --- model (L2) ---
     model: str = "twotower"  # "twotower" | "bert4rec" | "dlrm"
     embed_dim: int = 16
+    # custom CTR feature schema (dlrm only): categorical column names (one
+    # embedding table each, vocab sizes from size_map) and continuous column
+    # names for the bottom MLP.  Empty = the Goodreads TwoTower schema.
+    # This is what trains Criteo-class data (data/criteo_preprocessing.py,
+    # BASELINE.json north-star family): 26 cats + 13 conts by column name.
+    categorical_features: tuple[str, ...] = ()
+    continuous_features: tuple[str, ...] = ()
     # sequential-model params (Bert4Rec)
     n_heads: int = 2
     n_layers: int = 2
@@ -179,6 +186,18 @@ class Config:
             raise ValueError(f"unsupported write_format: {self.write_format!r}")
         if self.model not in ("twotower", "dlrm", "bert4rec"):
             raise ValueError(f"unknown model: {self.model!r}")
+        if ((self.categorical_features or self.continuous_features)
+                and self.model != "dlrm"):
+            raise ValueError(
+                "categorical_features/continuous_features define a custom CTR "
+                "schema, which only the dlrm model consumes (twotower and "
+                "bert4rec have fixed reference schemas)"
+            )
+        if self.model == "dlrm" and self.continuous_features and                 not self.categorical_features:
+            raise ValueError(
+                "continuous_features without categorical_features: a custom "
+                "schema must name its embedding-table columns"
+            )
         if self.embedding_sharding not in ("row", "column", "table", "replicated"):
             raise ValueError(f"unknown embedding_sharding: {self.embedding_sharding!r}")
         if self.lookup_mode not in ("gspmd", "psum", "alltoall"):
@@ -270,6 +289,9 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
 
     if "data_dir" in raw:
         raw["data_dir"] = Path(raw["data_dir"]).expanduser()
+    for key in ("categorical_features", "continuous_features"):
+        if key in raw:
+            raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
     cfg = Config(mesh=mesh, **raw)
     if not cfg.size_map:

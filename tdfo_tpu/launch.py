@@ -10,7 +10,9 @@ Subcommands:
   * ``train`` (default)      — build the Trainer from config and fit.
   * ``preprocess-ctr``       — TwoTower ETL (jax-flax/preprocessing parity).
   * ``preprocess-seq``       — Bert4Rec ETL (torchrec/preprocessing parity).
+  * ``preprocess-criteo``    — Criteo-format ETL (BASELINE.json DLRM family).
   * ``synth``                — write a synthetic raw-goodreads fixture.
+  * ``synth-criteo``         — write a synthetic Criteo train.txt fixture.
 """
 
 from __future__ import annotations
@@ -35,7 +37,8 @@ def _init_distributed(flag: str) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
     p.add_argument("command", nargs="?", default="train",
-                   choices=["train", "preprocess-ctr", "preprocess-seq", "synth"])
+                   choices=["train", "preprocess-ctr", "preprocess-seq",
+                            "preprocess-criteo", "synth", "synth-criteo"])
     p.add_argument("--config", default="config.toml", help="path to config.toml")
     p.add_argument("--data-dir", default=None, help="override config data_dir")
     p.add_argument("--distributed", default="auto", choices=["auto", "always", "never"],
@@ -55,6 +58,19 @@ def main(argv: list[str] | None = None) -> int:
 
         write_synthetic_goodreads(cfg.data_dir)
         print(f"synthetic goodreads raw files written to {cfg.data_dir}")
+        return 0
+    if args.command == "synth-criteo":
+        from tdfo_tpu.data.synthetic import write_synthetic_criteo
+
+        write_synthetic_criteo(cfg.data_dir)
+        print(f"synthetic criteo train.txt written to {cfg.data_dir}")
+        return 0
+    if args.command == "preprocess-criteo":
+        from tdfo_tpu.data.criteo_preprocessing import run_criteo_preprocessing
+
+        size_map = run_criteo_preprocessing(cfg.data_dir, seed=cfg.seed)
+        print(f"size_map: {{{len(size_map)} tables, "
+              f"max vocab {max(size_map.values())}}}")
         return 0
     if args.command == "preprocess-ctr":
         from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing

@@ -36,7 +36,29 @@ from tdfo_tpu.models.twotower import (
     _FEATURE_TO_INPUT,
 )
 
-__all__ = ["DLRMBackbone"]
+__all__ = ["DLRMBackbone", "generic_embedding_specs"]
+
+# default schema: the Goodreads CTR columns (TwoTower parity data)
+_DEFAULT_CAT_COLUMNS = tuple(_FEATURE_TO_INPUT[f] for f in TWOTOWER_CATEGORICAL)
+
+
+def generic_embedding_specs(
+    size_map: Mapping[str, int],
+    columns: tuple[str, ...],
+    embed_dim: int,
+    sharding: str = "row",
+    fused_threshold: int | None = 16384,
+):
+    """Declare one table per categorical COLUMN (custom-schema CTR: e.g. the
+    26 Criteo tables).  Init and fusion policy are shared with
+    :func:`~tdfo_tpu.models.twotower.ctr_embedding_specs` via
+    :func:`~tdfo_tpu.parallel.embedding.make_embedding_specs`."""
+    from tdfo_tpu.parallel.embedding import make_embedding_specs
+
+    return make_embedding_specs(
+        size_map, [(col, f"{col}_embed", col) for col in columns],
+        embed_dim, sharding, fused_threshold,
+    )
 
 
 class DLRMBackbone(nn.Module):
@@ -51,6 +73,10 @@ class DLRMBackbone(nn.Module):
     top_dims: tuple[int, ...] = (128, 64)
     dtype: jnp.dtype = jnp.float32
     kernel_init: Callable = jax.nn.initializers.glorot_uniform()
+    # feature schema by input-column name; defaults = the Goodreads CTR
+    # columns, overridden for custom schemas (Criteo: 26 cats + 13 conts)
+    cat_columns: tuple[str, ...] = _DEFAULT_CAT_COLUMNS
+    cont_columns: tuple[str, ...] = TWOTOWER_CONTINUOUS
 
     @nn.compact
     def __call__(
@@ -58,28 +84,32 @@ class DLRMBackbone(nn.Module):
     ) -> jax.Array:
         # bottom MLP over the continuous features, projected to embed_dim so
         # it joins the interaction as an (F+1)-th vector (standard DLRM).
-        x = jnp.stack(
-            [batch[c].astype(self.dtype) for c in TWOTOWER_CONTINUOUS], axis=-1
-        )  # [B, C]
-        for i, width in enumerate(self.bottom_dims):
-            x = nn.Dense(width, dtype=self.dtype, kernel_init=self.kernel_init,
-                         name=f"bottom_{i}")(x)
-            x = nn.relu(x)
-        x = nn.Dense(self.embed_dim, dtype=self.dtype, kernel_init=self.kernel_init,
-                     name="bottom_out")(x)
-        x = nn.relu(x)  # [B, D]
+        # A schema with NO continuous columns skips the bottom vector and
+        # interacts the embeddings alone.
+        stack = [embs[c].astype(self.dtype) for c in self.cat_columns]
+        if self.cont_columns:
+            x = jnp.stack(
+                [batch[c].astype(self.dtype) for c in self.cont_columns],
+                axis=-1,
+            )  # [B, C]
+            for i, width in enumerate(self.bottom_dims):
+                x = nn.Dense(width, dtype=self.dtype,
+                             kernel_init=self.kernel_init,
+                             name=f"bottom_{i}")(x)
+                x = nn.relu(x)
+            x = nn.Dense(self.embed_dim, dtype=self.dtype,
+                         kernel_init=self.kernel_init, name="bottom_out")(x)
+            x = nn.relu(x)  # [B, D]
+            stack.append(x)
 
-        vecs = jnp.stack(
-            [embs[_FEATURE_TO_INPUT[f]].astype(self.dtype) for f in TWOTOWER_CATEGORICAL]
-            + [x],
-            axis=1,
-        )  # [B, F+1, D]
+        vecs = jnp.stack(stack, axis=1)  # [B, F(+1), D]
         inter = jnp.einsum("bfd,bgd->bfg", vecs, vecs)  # one MXU contraction
         f = vecs.shape[1]
         iu, ju = np.triu_indices(f, k=1)  # static at trace time
         flat = inter[:, iu, ju]  # [B, F(F+1)/2 - F] upper-triangle pairs
 
-        top = jnp.concatenate([x, flat], axis=-1)
+        top = (jnp.concatenate([x, flat], axis=-1) if self.cont_columns
+               else flat)
         for i, width in enumerate(self.top_dims):
             top = nn.Dense(width, dtype=self.dtype, kernel_init=self.kernel_init,
                            name=f"top_{i}")(top)

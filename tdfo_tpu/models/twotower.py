@@ -156,29 +156,19 @@ def ctr_embedding_specs(
 ):
     """Declare the 7 CTR tables for a ShardedEmbeddingCollection.
 
-    Table ``{feat}_embed`` serves the corresponding input column; init is
-    uniform with the glorot bound ``sqrt(6 / (V + D))`` so the DMP regime is
-    init-equivalent to the dense regime's ``nn.Embed`` glorot tables.
-    Tables with more than ``fused_threshold`` rows use fused fat-row storage
-    (in-place DMA Adam; O(touched rows) updates at any scale); pass ``None``
-    to disable.
+    Table ``{feat}_embed`` serves the corresponding input column; init and
+    fusion policy live in :func:`~tdfo_tpu.parallel.embedding.make_embedding_specs`
+    (shared with the custom-schema builder so the two CTR paths never
+    diverge).
     """
-    from tdfo_tpu.parallel.embedding import EmbeddingSpec
+    from tdfo_tpu.parallel.embedding import make_embedding_specs
 
-    return [
-        EmbeddingSpec(
-            name=f"{feat}_embed",
-            num_embeddings=int(size_map[feat]),
-            embedding_dim=embed_dim,
-            features=(_FEATURE_TO_INPUT[feat],),
-            sharding=sharding,
-            init_scale=math.sqrt(6.0 / (int(size_map[feat]) + embed_dim)),
-            fused=(fused_threshold is not None
-                   and sharding in ("row", "replicated")
-                   and int(size_map[feat]) > fused_threshold),
-        )
-        for feat in TWOTOWER_CATEGORICAL
-    ]
+    return make_embedding_specs(
+        size_map,
+        [(feat, f"{feat}_embed", _FEATURE_TO_INPUT[feat])
+         for feat in TWOTOWER_CATEGORICAL],
+        embed_dim, sharding, fused_threshold,
+    )
 
 
 def dummy_batch(batch_size: int = 1) -> dict[str, jnp.ndarray]:

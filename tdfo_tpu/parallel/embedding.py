@@ -50,7 +50,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdfo_tpu.core.mesh import MODEL_AXIS
 
-__all__ = ["EmbeddingSpec", "ShardedEmbeddingCollection"]
+__all__ = ["EmbeddingSpec", "ShardedEmbeddingCollection", "make_embedding_specs"]
+
+
+def make_embedding_specs(
+    size_map,
+    entries,
+    embed_dim: int,
+    sharding: str = "row",
+    fused_threshold: int | None = 16384,
+) -> "list[EmbeddingSpec]":
+    """One table per ``(size_map key, table name, input column)`` entry —
+    the single source of truth for the CTR families' init and fusion policy:
+    glorot-bound uniform init ``sqrt(6 / (V + D))`` (init-equivalent to the
+    dense regime's ``nn.Embed``), fat-row fused storage above
+    ``fused_threshold`` rows (``None`` disables)."""
+    import math
+
+    specs = []
+    for key, name, column in entries:
+        vocab = int(size_map[key])
+        specs.append(EmbeddingSpec(
+            name=name,
+            num_embeddings=vocab,
+            embedding_dim=embed_dim,
+            features=(column,),
+            sharding=sharding,
+            init_scale=math.sqrt(6.0 / (vocab + embed_dim)),
+            fused=(fused_threshold is not None
+                   and sharding in ("row", "replicated")
+                   and vocab > fused_threshold),
+        ))
+    return specs
 
 
 @dataclass(frozen=True)

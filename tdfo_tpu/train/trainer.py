@@ -93,22 +93,31 @@ def pad_batch(batch: dict[str, np.ndarray], size: int) -> tuple[dict[str, np.nda
     return out, w
 
 
-def _ctr_eval_schema() -> dict[str, tuple]:
-    """Post-rename eval-batch schema for the CTR family: key ->
-    (numpy dtype, trailing shape).  The authority for (a) restricting real
-    batches so every host ships an identical pytree and (b) synthesising
-    zero-weight template batches on hosts with no eval rows — dtypes match
-    what the CTR preprocessing writes to parquet."""
+def _ctr_columns(cfg: Config) -> tuple[tuple[str, ...], tuple[str, ...]]:
+    """(categorical input columns, continuous columns) for the CTR family —
+    the custom schema (``categorical_features``, e.g. Criteo's 26+13) or the
+    Goodreads TwoTower default."""
+    if cfg.categorical_features:
+        return tuple(cfg.categorical_features), tuple(cfg.continuous_features)
     from tdfo_tpu.models.twotower import (
         TWOTOWER_CATEGORICAL,
         TWOTOWER_CONTINUOUS,
         _FEATURE_TO_INPUT,
     )
 
-    schema: dict[str, tuple] = {
-        _FEATURE_TO_INPUT[f]: (np.int32, ()) for f in TWOTOWER_CATEGORICAL
-    }
-    for c in TWOTOWER_CONTINUOUS:
+    return (tuple(_FEATURE_TO_INPUT[f] for f in TWOTOWER_CATEGORICAL),
+            TWOTOWER_CONTINUOUS)
+
+
+def _ctr_eval_schema(cat_columns: tuple[str, ...],
+                     cont_columns: tuple[str, ...]) -> dict[str, tuple]:
+    """Post-rename eval-batch schema for the CTR family: key ->
+    (numpy dtype, trailing shape).  The authority for (a) restricting real
+    batches so every host ships an identical pytree and (b) synthesising
+    zero-weight template batches on hosts with no eval rows — dtypes match
+    what the CTR preprocessing writes to parquet."""
+    schema: dict[str, tuple] = {c: (np.int32, ()) for c in cat_columns}
+    for c in cont_columns:
         schema[c] = (np.float32, ())
     schema["label"] = (np.int8, ())
     return schema
@@ -314,7 +323,7 @@ class Trainer:
             self.train_step = _wrap_auc_step(inner)
         self._train_auc_enabled = True
         self.eval_step = make_eval_step(mesh=self.mesh)
-        self._eval_schema = _ctr_eval_schema()
+        self._eval_schema = _ctr_eval_schema(*_ctr_columns(cfg))
         self.eval_accum = _make_ctr_eval_accum(
             lambda state, batch: state.apply_fn({"params": state.params}, batch)
         )
@@ -336,18 +345,30 @@ class Trainer:
         from tdfo_tpu.models.twotower import TWOTOWER_CATEGORICAL
 
         cfg = self.config
-        # every table's vocab must be present, not just user/item — a partial
-        # size_map should fail with this message, not a KeyError downstream
-        missing = [f for f in TWOTOWER_CATEGORICAL if f not in cfg.size_map]
+        cat_cols, cont_cols = _ctr_columns(cfg)
+        custom = bool(cfg.categorical_features)
+        # every table's vocab must be present — a partial size_map should
+        # fail with this message, not a KeyError downstream
+        vocab_keys = cat_cols if custom else TWOTOWER_CATEGORICAL
+        missing = [f for f in vocab_keys if f not in cfg.size_map]
         if missing:
             raise ValueError(
                 f"{cfg.model} needs vocab sizes {missing} in size_map (run preprocessing)"
             )
         dtype = compute_dtype(cfg.mixed_precision)
         sharding = cfg.embedding_sharding if cfg.model_parallel else "replicated"
+        if custom:
+            from tdfo_tpu.models.dlrm import generic_embedding_specs
+
+            specs = generic_embedding_specs(
+                cfg.size_map, cat_cols, cfg.embed_dim, sharding,
+                fused_threshold=cfg.effective_fused_threshold)
+        else:
+            specs = ctr_embedding_specs(
+                cfg.size_map, cfg.embed_dim, sharding,
+                fused_threshold=cfg.effective_fused_threshold)
         coll = ShardedEmbeddingCollection(
-            ctr_embedding_specs(cfg.size_map, cfg.embed_dim, sharding,
-                                fused_threshold=cfg.effective_fused_threshold),
+            specs,
             mesh=self.mesh,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
         )
@@ -356,13 +377,15 @@ class Trainer:
         if cfg.model == "dlrm":
             from tdfo_tpu.models.dlrm import DLRMBackbone
 
-            backbone = DLRMBackbone(embed_dim=cfg.embed_dim, dtype=dtype)
+            backbone = DLRMBackbone(embed_dim=cfg.embed_dim, dtype=dtype,
+                                    cat_columns=cat_cols,
+                                    cont_columns=cont_cols)
         else:
             backbone = TwoTowerBackbone(embed_dim=cfg.embed_dim, dtype=dtype)
         dummy_embs = {
             f: jnp.zeros((1, cfg.embed_dim), jnp.float32) for f in coll.features()
         }
-        dummy_cont = {c: jnp.zeros((1,), jnp.float32) for c in TWOTOWER_CONTINUOUS}
+        dummy_cont = {c: jnp.zeros((1,), jnp.float32) for c in cont_cols}
         dense = backbone.init(k_dense, dummy_embs, dummy_cont)["params"]
         self.coll = coll
         self.state = _commit_replicated(SparseTrainState.create(
@@ -388,7 +411,7 @@ class Trainer:
             self.train_step = _wrap_auc_step(inner, donate_state=False)
         self._train_auc_enabled = True
         self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
-        self._eval_schema = _ctr_eval_schema()
+        self._eval_schema = _ctr_eval_schema(cat_cols, cont_cols)
         features, mode = list(coll.features()), cfg.lookup_mode
 
         def sparse_logits(state, batch):
