@@ -58,6 +58,15 @@ SIZE_MAP = {
     "format": 16, "publisher": 5_000, "pub_decade": 16,
 }
 
+# Criteo-Kaggle per-column vocabulary sizes (the standard 26-table profile
+# used by the public DLRM benchmarks) — 33.76M embedding rows total, the
+# BASELINE.json "DLRM-Criteo examples/sec/chip" workload.
+CRITEO_KAGGLE_VOCABS = (
+    1460, 583, 10131227, 2202608, 305, 24, 12517, 633, 3, 93145, 5683,
+    8351593, 3194, 27, 14992, 5461306, 10, 5652, 2173, 4, 7046547, 18, 15,
+    286181, 105, 142572,
+)
+
 
 def chip_peaks() -> tuple[float, float, bool]:
     """(peak bf16 TFLOP/s, HBM GB/s, spec_assumed).  ``spec_assumed`` is True
@@ -217,6 +226,106 @@ def build_train_bench(batch_size: int, embed_dim: int):
 # SparseCore units on larger TPUs exist precisely for this); the byte floor
 # is kept as the REFUSAL threshold because it is the only bound that is
 # provably irreducible.
+
+
+def _make_criteo_host_batch(rng: np.random.Generator, b: int) -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {
+        f"cat_{i}": rng.integers(0, v, b, dtype=np.int32)
+        for i, v in enumerate(CRITEO_KAGGLE_VOCABS)
+    }
+    for i in range(13):
+        out[f"cont_{i}"] = rng.random(b, dtype=np.float32)
+    out["label"] = rng.integers(0, 2, b).astype(np.float32)
+    return out
+
+
+def build_criteo_train_bench(batch_size: int, embed_dim: int):
+    """DLRM over the Criteo-Kaggle table profile (26 tables, 33.76M rows):
+    the BASELINE.json north-star metric measured directly.  Plain-table
+    STACKING puts all 26 tables in one array (one dedupe + one
+    gather/scatter per step); the rowwise-adagrad tier (fbgemm's huge-table
+    configuration) keeps optimizer state at one f32 per row.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+    from tdfo_tpu.models.dlrm import DLRMBackbone, generic_embedding_specs
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+    from tdfo_tpu.train.ctr import ctr_sparse_forward
+    from tdfo_tpu.train.sparse_step import SparseTrainState, make_sparse_train_step
+
+    platform = jax.devices()[0].platform
+    dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    cats = tuple(f"cat_{i}" for i in range(26))
+    conts = tuple(f"cont_{i}" for i in range(13))
+    size_map = {c: v for c, v in zip(cats, CRITEO_KAGGLE_VOCABS)}
+    coll = ShardedEmbeddingCollection(
+        generic_embedding_specs(size_map, cats, embed_dim, "row",
+                                fused_threshold=None),
+        mesh=mesh, stack_tables=True,
+    )
+    # shapes only — the real tables are built INSIDE the jitted chain (a
+    # per-chain constant the differencing cancels): an 8.65 GB table passed
+    # as a chain ARGUMENT would need disjoint input+output copies (~17 GB,
+    # OOM); zeroed in-chain tables alias through the scan carry and row-RMW
+    # timing is content-independent (cf. bench_big_table).
+    table_shapes = jax.eval_shape(coll.init, jax.random.key(0))
+    backbone = DLRMBackbone(embed_dim=embed_dim, dtype=dtype,
+                            cat_columns=cats, cont_columns=conts)
+    dummy_embs = {f: jnp.zeros((1, embed_dim), jnp.float32)
+                  for f in coll.features()}
+    dummy_cont = {c: jnp.zeros((1,)) for c in conts}
+    import optax
+
+    dense = backbone.init(jax.random.key(1), dummy_embs, dummy_cont)["params"]
+    opt = sparse_optimizer("rowwise_adagrad", lr=3e-4)
+    b = batch_size * mesh.shape["data"]
+    inner = make_sparse_train_step(
+        coll, ctr_sparse_forward(backbone), jit=False, donate=False
+    )
+
+    def run(k):
+        @jax.jit
+        def chain(dense, stack):
+            tables = {n: jnp.zeros(sh.shape, sh.dtype)
+                      for n, sh in table_shapes.items()}
+            state = SparseTrainState.create(
+                dense_params=dense,
+                tx=optax.adamw(3e-4, weight_decay=1e-4),
+                tables=tables,
+                sparse_opt=opt,
+            )
+            final, losses = jax.lax.scan(lambda st, bt: inner(st, bt), state, stack)
+            return losses[-1]
+
+        return lambda stack: chain(dense, stack)
+
+    unique_rows_per_step: list[float] = []
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        host = _make_criteo_host_batch(r, b * k)
+        ids = {c: host[c].reshape(k, b) for c in cats}
+        for step in range(k):
+            unique_rows_per_step.append(
+                float(sum(len(np.unique(v[step])) for v in ids.values()))
+            )
+        return (_stack_batches(mesh, host, k, b),)
+
+    dense_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(dense))
+    flops_per_example = dense_flops_per_example(dense)
+
+    def floor_bytes_fn() -> float:
+        # rowwise adagrad reads+writes table rows and the per-row accumulator
+        # cell: (2 x U x D + 2 x U) x 4B, plus the dense 6x AdamW sweep
+        u_mean = float(np.mean(unique_rows_per_step)) if unique_rows_per_step else 0.0
+        return (2.0 * u_mean * embed_dim + 2.0 * u_mean) * 4.0 + 6.0 * dense_bytes
+
+    return run, make_args, b, floor_bytes_fn, flops_per_example
 
 
 def build_sparse_train_bench(batch_size: int, embed_dim: int,
@@ -463,11 +572,17 @@ def main() -> None:
     ap.add_argument("--dense", action="store_true",
                     help="bench the dense regime (nn.Embed + dense AdamW) "
                          "instead of the sparse/DMP headline")
-    ap.add_argument("--model", default="twotower", choices=["twotower", "dlrm"],
-                    help="CTR head for the sparse headline (dlrm = the "
-                         "BASELINE.json north-star family)")
+    ap.add_argument("--model", default="twotower",
+                    choices=["twotower", "dlrm", "dlrm-criteo"],
+                    help="CTR head for the sparse headline (dlrm-criteo = "
+                         "the BASELINE.json north-star workload: 26 "
+                         "Criteo-Kaggle tables, 33.76M rows, stacked, "
+                         "rowwise-adagrad)")
     ap.add_argument("--skip-big-table", action="store_true")
     args = ap.parse_args()
+    if args.model == "dlrm-criteo" and args.embed_dim > 32:
+        ap.error("dlrm-criteo: use --embed-dim 16 (the standard Kaggle-DLRM "
+                 "dim; XLA lane-pads wider narrow tables past v5e HBM)")
     if args.dense and args.model != "twotower":
         # validate BEFORE measuring: a bad combination must not waste a run
         ap.error("--model is only valid for the sparse headline (drop --dense)")
@@ -477,6 +592,10 @@ def main() -> None:
     if args.dense:
         run, make_args, global_batch, floor_bytes, flops_per_ex = build_train_bench(
             args.batch_size, args.embed_dim
+        )
+    elif args.model == "dlrm-criteo":
+        run, make_args, global_batch, floor_bytes, flops_per_ex = (
+            build_criteo_train_bench(args.batch_size, args.embed_dim)
         )
     else:
         run, make_args, global_batch, floor_bytes, flops_per_ex = (
@@ -531,7 +650,7 @@ def main() -> None:
         # twotower baseline record (config equality gates vs_baseline)
         bench_config["model"] = model_name
     record = {
-        "metric": f"{model_name}_train_examples_per_sec_per_chip",
+        "metric": f"{model_name.replace('-', '_')}_train_examples_per_sec_per_chip",
         "value": round(examples_per_sec_per_chip, 1),
         "unit": "examples/sec/chip",
         "regime": "dense_adamw" if args.dense else "dmp_sparse",

@@ -142,6 +142,12 @@ class Config:
     # configuration); non-adam kinds disable fat-row fused storage (its
     # packed moments are adam-specific).
     sparse_optimizer: str = "adam"
+    # stack PLAIN (non-fused) embedding tables sharing (dim, sharding) into
+    # one array (the 2D analogue of the always-on fat-row stacking): a
+    # many-table model (DLRM-Criteo, 26 tables) then pays ONE dedupe + ONE
+    # gather/scatter per step instead of one per table.  Opt-in because it
+    # changes checkpoint state keys.
+    stack_tables: bool = False
     # vocab size above which DMP-regime tables use fused fat-row storage
     # (ops/pallas_kernels.fat_layout + the in-place DMA Adam kernel); smaller
     # tables take the one-hot MXU update.  The kernel choice itself is

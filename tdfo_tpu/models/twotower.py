@@ -28,7 +28,6 @@ TPU-first departures from the reference:
 
 from __future__ import annotations
 
-import math
 from typing import Callable, Mapping
 
 import jax

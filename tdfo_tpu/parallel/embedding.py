@@ -126,6 +126,7 @@ class ShardedEmbeddingCollection:
         mesh: Mesh | None = None,
         axis: str = MODEL_AXIS,
         a2a_capacity_factor: float | None = None,
+        stack_tables: bool = False,
     ):
         """``a2a_capacity_factor``: per-shard send-bucket capacity for the
         alltoall lookup program, as a multiple of the balanced share
@@ -133,7 +134,14 @@ class ShardedEmbeddingCollection:
         (capacity = local batch, correct for ANY skew); a finite factor
         (e.g. 2.0) shrinks the a2a payload by ~n_shards/factor at the cost
         that ids beyond a bucket's capacity resolve to ZERO vectors under
-        extreme skew (torchrec-planner-style capacity semantics)."""
+        extreme skew (torchrec-planner-style capacity semantics).
+
+        ``stack_tables``: also stack PLAIN (non-fused) tables sharing
+        (dim, sharding, dtype) into one ``__tablestack_`` array — the 2D
+        analogue of the always-on fat stacking, so a many-table model
+        (DLRM-Criteo: 26 tables) pays ONE dedupe + ONE gather/scatter per
+        step instead of one per table.  Opt-in because it changes the state
+        pytree layout (checkpoint keys)."""
         self.specs = {s.name: s for s in specs}
         if len(self.specs) != len(specs):
             raise ValueError("duplicate table names")
@@ -171,24 +179,43 @@ class ShardedEmbeddingCollection:
         # step's per-array grouping makes that automatic).
         self._fat_groups: dict[str, tuple[str, int, list[EmbeddingSpec]]] = {}
         self._fat_member_to_stack: dict[str, str] = {}
-        by_fat_key: dict[tuple[int, str], list[EmbeddingSpec]] = {}
-        for s in specs:
-            if s.fused and s.sharding in ("row", "replicated"):
-                by_fat_key.setdefault((s.embedding_dim, s.sharding), []).append(s)
-        for (dim, shard_kind), group in sorted(by_fat_key.items(),
-                                               key=lambda kv: str(kv[0])):
-            if len(group) < 2:
-                continue  # single tables keep their own array (and name)
-            gname = f"__fatstack_{dim}_{shard_kind}"
-            total = sum(s.num_embeddings for s in group)
-            if shard_kind == "row":
-                total = _round_up(total, self.n_shards)
-            off = 0
-            for s in group:
-                self._stack_rows[s.name] = (off, total)
-                self._fat_member_to_stack[s.name] = gname
-                off += s.num_embeddings
-            self._fat_groups[gname] = (shard_kind, dim, group)
+
+        def build_stacks(members, fused: bool):
+            by_key: dict[tuple, list[EmbeddingSpec]] = {}
+            for s in members:
+                by_key.setdefault(
+                    (s.embedding_dim, s.sharding, str(s.dtype)), []).append(s)
+            prefix = "__fatstack_" if fused else "__tablestack_"
+            for (dim, shard_kind, dt), group in sorted(
+                    by_key.items(), key=lambda kv: str(kv[0])):
+                if len(group) < 2:
+                    continue  # single tables keep their own array (and name)
+                # plain stacks carry the dtype in the name: the GROUP key
+                # includes it, so two same-(dim, sharding) groups of
+                # different dtypes must not collide on one array name
+                # (fat stacks are f32-only, no collision possible)
+                gname = (f"{prefix}{dim}_{shard_kind}" if fused
+                         else f"{prefix}{dim}_{shard_kind}_{dt}")
+                total = sum(s.num_embeddings for s in group)
+                if shard_kind == "row":
+                    total = _round_up(total, self.n_shards)
+                off = 0
+                for s in group:
+                    self._stack_rows[s.name] = (off, total)
+                    self._fat_member_to_stack[s.name] = gname
+                    off += s.num_embeddings
+                self._fat_groups[gname] = (shard_kind, dim, group)
+
+        build_stacks(
+            [s for s in specs if s.fused and s.sharding in ("row", "replicated")],
+            fused=True,
+        )
+        if stack_tables:
+            build_stacks(
+                [s for s in specs
+                 if not s.fused and s.sharding in ("row", "replicated")],
+                fused=False,
+            )
         if self._table_wise:
             if mesh is None:
                 raise ValueError("table-wise sharding requires a mesh")
@@ -288,16 +315,20 @@ class ShardedEmbeddingCollection:
             sh = NamedSharding(self.mesh, P(self.axis, None))
             tables[gname] = jax.device_put(t, sh)
         for gname, (shard_kind, dim, group) in self._fat_groups.items():
-            from tdfo_tpu.ops.pallas_kernels import fat_pack
+            if gname.startswith("__fatstack_"):
+                from tdfo_tpu.ops.pallas_kernels import fat_pack
 
-            t = assemble_stack(group, next(key_iter), jnp.float32)
-            z = jnp.zeros_like(t)
-            fat = fat_pack(t, z, z)  # [total, T, 128]
+                t = assemble_stack(group, next(key_iter), jnp.float32)
+                z = jnp.zeros_like(t)
+                arr = fat_pack(t, z, z)  # [total, T, 128]
+            else:  # plain 2D table stack (stack_tables=True)
+                arr = assemble_stack(group, next(key_iter), group[0].dtype)
             if self.mesh is not None:
-                spec_p = (P(self.axis, None, None) if shard_kind == "row"
+                trailing = (None,) * (arr.ndim - 1)
+                spec_p = (P(self.axis, *trailing) if shard_kind == "row"
                           else P())
-                fat = jax.device_put(fat, NamedSharding(self.mesh, spec_p))
-            tables[gname] = fat
+                arr = jax.device_put(arr, NamedSharding(self.mesh, spec_p))
+            tables[gname] = arr
         return tables
 
     # -------------------------------------------------------------- lookup
@@ -334,7 +365,7 @@ class ShardedEmbeddingCollection:
     def array_embedding_dim(self, array_name: str) -> int:
         """Embedding dim of an ``init()`` pytree entry (stacked groups carry
         it in their name; fat arrays don't expose it in their shape)."""
-        if array_name.startswith("__fatstack_"):
+        if array_name in self._fat_groups:  # fat AND plain table stacks
             return self._fat_groups[array_name][1]
         if array_name.startswith("__stack_"):
             return int(array_name.removeprefix("__stack_"))
@@ -356,7 +387,8 @@ class ShardedEmbeddingCollection:
         d = self.array_embedding_dim(array_name)
         if array_name in self._fat_groups:
             shard_kind = self._fat_groups[array_name][0]
-            fused, row_sharded = True, shard_kind == "row"
+            fused = array_name.startswith("__fatstack_")
+            row_sharded = shard_kind == "row"
         elif array_name.startswith("__stack_"):
             fused, row_sharded = False, True
         else:

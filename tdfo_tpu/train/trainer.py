@@ -371,6 +371,7 @@ class Trainer:
             specs,
             mesh=self.mesh,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
+            stack_tables=cfg.stack_tables,
         )
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)

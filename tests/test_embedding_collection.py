@@ -289,3 +289,51 @@ class TestFatStacking:
         new, _ = coll.sparse_update(opt, stack, tables[stack], slots, ids, g)
         assert new.shape == tables[stack].shape
         assert not np.allclose(np.asarray(new), np.asarray(tables[stack]))
+
+
+def test_plain_table_stacking_opt_in():
+    """stack_tables=True groups PLAIN same-shape tables into one 2D array
+    (the DLRM-Criteo many-table path); default off keeps per-table arrays."""
+    specs = [
+        EmbeddingSpec("a", 20, 8, features=("fa",), sharding="row"),
+        EmbeddingSpec("b", 12, 8, features=("fb",), sharding="row"),
+    ]
+    coll = ShardedEmbeddingCollection(specs, stack_tables=True)
+    tables = coll.init(jax.random.key(0))
+    (stack,) = tables
+    assert stack.startswith("__tablestack_") and tables[stack].shape == (32, 8)
+    aname, spec_b, off_b = coll.resolve("fb")
+    assert aname == stack and off_b == 20
+    ids = jnp.array([0, 5], jnp.int32)
+    out = coll.lookup(tables, {"fb": ids})["fb"]
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(tables[stack][20 + np.asarray(ids)]))
+    # default: no stacking
+    coll2 = ShardedEmbeddingCollection(specs)
+    assert set(coll2.init(jax.random.key(0))) == {"a", "b"}
+
+
+def test_plain_stack_dtype_groups_do_not_collide():
+    """Two same-(dim, sharding) groups of DIFFERENT dtypes must form two
+    stacks; the overwritten-group bug served rows of the wrong table."""
+    import jax.numpy as jnp_
+
+    specs = [
+        EmbeddingSpec("a", 20, 8, features=("fa",), sharding="row"),
+        EmbeddingSpec("b", 12, 8, features=("fb",), sharding="row"),
+        EmbeddingSpec("c", 10, 8, features=("fc",), sharding="row",
+                      dtype=jnp_.bfloat16),
+        EmbeddingSpec("d", 10, 8, features=("fd",), sharding="row",
+                      dtype=jnp_.bfloat16),
+    ]
+    coll = ShardedEmbeddingCollection(specs, stack_tables=True)
+    tables = coll.init(jax.random.key(0))
+    stacks = sorted(n for n in tables if n.startswith("__tablestack_"))
+    assert len(stacks) == 2, tables.keys()
+    dname, _, off_d = coll.resolve("fd")
+    assert tables[dname].dtype == jnp_.bfloat16 and off_d == 10
+    ids = jnp.array([0, 3], jnp.int32)
+    out = coll.lookup(tables, {"fd": ids})["fd"]
+    np.testing.assert_array_equal(
+        np.asarray(out, np.float32),
+        np.asarray(tables[dname][10 + np.asarray(ids)], np.float32))
