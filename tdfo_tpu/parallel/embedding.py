@@ -183,17 +183,18 @@ class ShardedEmbeddingCollection:
         def build_stacks(members, fused: bool):
             by_key: dict[tuple, list[EmbeddingSpec]] = {}
             for s in members:
+                # canonical dtype NAME ("float32"), never str(class): two
+                # spellings of one dtype must land in one group, and the
+                # name becomes a checkpoint key (fused storage is f32-only,
+                # so fused groups need no dtype discriminator at all)
+                dt = "" if fused else jnp.dtype(s.dtype).name
                 by_key.setdefault(
-                    (s.embedding_dim, s.sharding, str(s.dtype)), []).append(s)
+                    (s.embedding_dim, s.sharding, dt), []).append(s)
             prefix = "__fatstack_" if fused else "__tablestack_"
             for (dim, shard_kind, dt), group in sorted(
                     by_key.items(), key=lambda kv: str(kv[0])):
                 if len(group) < 2:
                     continue  # single tables keep their own array (and name)
-                # plain stacks carry the dtype in the name: the GROUP key
-                # includes it, so two same-(dim, sharding) groups of
-                # different dtypes must not collide on one array name
-                # (fat stacks are f32-only, no collision possible)
                 gname = (f"{prefix}{dim}_{shard_kind}" if fused
                          else f"{prefix}{dim}_{shard_kind}_{dt}")
                 total = sum(s.num_embeddings for s in group)
@@ -371,7 +372,8 @@ class ShardedEmbeddingCollection:
             return int(array_name.removeprefix("__stack_"))
         return self.specs[array_name].embedding_dim
 
-    def sparse_update(self, opt, array_name: str, table, slots, ids, grads):
+    def sparse_update(self, opt, array_name: str, table, slots, ids, grads,
+                      max_distinct: int | None = None):
         """Apply the row-sparse optimizer to one table, sharding-aware.
 
         For fused (fat-row) tables ROW-SHARDED over a real model axis the
@@ -399,7 +401,8 @@ class ShardedEmbeddingCollection:
             and self.mesh is not None and self.n_shards > 1
         )
         if not needs_shard_map:
-            return opt.update(table, slots, ids, grads, embedding_dim=d)
+            return opt.update(table, slots, ids, grads, embedding_dim=d,
+                              capacity=max_distinct, max_distinct=max_distinct)
 
         from tdfo_tpu.core.mesh import DATA_AXIS
         from tdfo_tpu.ops.sparse import fat_adam_update
@@ -424,6 +427,7 @@ class ShardedEmbeddingCollection:
                 fat_shard, count, masked, g_masked, embedding_dim=d,
                 lr=opt.lr, b1=opt.b1, b2=opt.b2, eps=opt.eps,
                 weight_decay=opt.weight_decay,
+                capacity=max_distinct, max_distinct=max_distinct,
             )
             return new_fat, new_count
 

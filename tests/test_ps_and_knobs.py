@@ -151,3 +151,28 @@ def test_sparse_optimizer_knob(tmp_path):
 
     with pytest.raises(ValueError, match="sparse_optimizer"):
         read_configs(None, sparse_optimizer="lion")
+
+
+def test_stack_tables_knob(tmp_path):
+    """Config(stack_tables=true) must reach the collection through the
+    Trainer (observable: the state pytree holds one __tablestack_ array)."""
+    from tdfo_tpu.core.config import read_configs
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.train.trainer import Trainer
+
+    d = tmp_path / "gr"
+    write_synthetic_goodreads(d, n_users=50, n_books=70,
+                              interactions_per_user=(12, 22), seed=17)
+    ctr = run_ctr_preprocessing(d)
+    common = dict(
+        data_dir=d, model="dlrm", model_parallel=True, embed_dim=8,
+        per_device_train_batch_size=16, per_device_eval_batch_size=16,
+        shuffle_buffer_size=200, size_map=ctr,
+    )
+    tr_on = Trainer(read_configs(None, stack_tables=True, **common))
+    stacks = [n for n in tr_on.state.tables if n.startswith("__tablestack_")]
+    assert stacks, tr_on.state.tables.keys()
+    assert all(c.isalnum() or c == "_" for c in stacks[0]), stacks[0]
+    tr_off = Trainer(read_configs(None, **common))
+    assert not any(n.startswith("__tablestack_") for n in tr_off.state.tables)

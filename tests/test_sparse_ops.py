@@ -205,3 +205,30 @@ def test_rowwise_adagrad_semantics():
     step2 = np.asarray(new_table[1] - t2[1])
     step1 = np.asarray(table[1] - new_table[1])
     assert (step2 < step1).all()
+
+
+def test_max_distinct_licenses_tight_capacity():
+    """A caller-proven distinct bound licenses capacity < B, and results are
+    identical to the full-capacity run (fewer sentinel slots only)."""
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer("adam", lr=0.1, small_vocab_threshold=0)
+    r = np.random.default_rng(3)
+    # two "features": 16 ids into a 6-row region + 16 into rows [6, 106)
+    ids = jnp.concatenate([
+        jnp.asarray(r.integers(0, 6, 16), jnp.int32),
+        jnp.asarray(6 + r.integers(0, 100, 16), jnp.int32),
+    ])
+    g = jnp.asarray(r.standard_normal((32, 4)), jnp.float32)
+    table = jnp.asarray(r.standard_normal((106, 4)), jnp.float32)
+    slots = opt.init(table)
+    bound = 6 + 16  # min(16, 6) + min(16, 100)
+    t_full, s_full = opt.update(table, slots, ids, g)
+    t_tight, s_tight = opt.update(table, slots, ids, g,
+                                  capacity=bound, max_distinct=bound)
+    np.testing.assert_allclose(np.asarray(t_full), np.asarray(t_tight))
+    np.testing.assert_allclose(np.asarray(s_full[0]), np.asarray(s_tight[0]))
+    import pytest
+
+    with pytest.raises(ValueError, match="max_distinct"):
+        opt.update(table, slots, ids, g, capacity=8, max_distinct=None)
