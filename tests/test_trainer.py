@@ -355,3 +355,32 @@ def test_param_summary(prepared_dir, capsys):
     # fat tables report TRUE param counts (vocab x dim), not storage size
     s = param_summary(tr.state.dense_params, tables=tr.state.tables, coll=tr.coll)
     assert "tables/" in s
+
+
+def test_preempted_save_does_not_poison_resume(prepared_dir, tmp_path):
+    """A kill DURING checkpoint save leaves an in-progress tmp dir; the
+    manager must keep resuming from the last COMPLETE checkpoint (the
+    BackupAndRestore failure-recovery contract, tensorflow2/train_ps.py:156)."""
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+
+    d, ctr, _ = prepared_dir
+    cfg = read_configs(
+        None, data_dir=d, model="twotower", n_epochs=1, learning_rate=3e-3,
+        embed_dim=8, per_device_train_batch_size=16,
+        per_device_eval_batch_size=16, shuffle_buffer_size=500,
+        log_every_n_steps=1000, size_map=ctr,
+        checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every_n_epochs=1,
+    )
+    tr = Trainer(cfg)
+    tr.fit()  # writes a complete checkpoint for epoch 0
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    assert mgr.latest_step() == 0
+    mgr.close()
+    # simulate a preemption mid-save of epoch 1: orbax-style in-progress dir
+    # plus a stray empty step dir with no committed payload
+    (tmp_path / "ckpt" / "1.orbax-checkpoint-tmp-1234567").mkdir()
+    tr2 = Trainer(cfg.replace(n_epochs=2))
+    assert tr2._ckpt.latest_step() == 0  # incomplete save ignored
+    m = tr2.fit()  # resumes from epoch 0 and completes epoch 1
+    assert 0.0 <= m["auc"] <= 1.0
+    assert tr2._ckpt.latest_step() == 1
