@@ -285,7 +285,8 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
     opt = sparse_optimizer("rowwise_adagrad", lr=3e-4)
     b = batch_size * mesh.shape["data"]
     inner = make_sparse_train_step(
-        coll, ctr_sparse_forward(backbone), jit=False, donate=False
+        coll, ctr_sparse_forward(backbone), jit=False, donate=False,
+        dedup_lookup=True,
     )
 
     def run(k):

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 
 __all__ = [
     "dedupe_grads",
+    "dedupe_ids",
+    "fat_adam_apply_unique",
     "sparse_sgd",
     "sparse_adam",
     "sparse_adagrad",
@@ -81,8 +83,39 @@ def dedupe_grads(
             "Undersizing silently DROPS the largest-id updates, so it is "
             "rejected at trace time."
         )
-    oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
-    clean = jnp.where(ids >= 0, ids, oob)
+    uids, seg, valid = _dedupe_ids_impl(ids, capacity)
+    g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
+    g = jnp.where(valid[:, None], g, 0.0)
+    return uids, g, valid
+
+
+def dedupe_ids(
+    ids: jax.Array, *, capacity: int | None = None,
+    vocab: int | None = None, max_distinct: int | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The id half of :func:`dedupe_grads`: ``ids[B] -> (uids[C], seg[B],
+    valid[C])`` with ``ids == uids[seg]`` for non-negative ids.
+
+    The deduplicated-lookup path uses this ONCE per step per table array:
+    the forward gathers ``table[uids]`` (a compact, cache-resident block)
+    and expands by ``seg``; the backward segment-sums the embedding grads by
+    the SAME ``seg`` — one sort serves both directions instead of a dedupe
+    in the update plus a full-width gather in the forward.  Capacity
+    licensing matches :func:`dedupe_grads`.
+    """
+    b = ids.shape[0]
+    capacity = capacity or b
+    if (capacity < b and (vocab is None or capacity < vocab)
+            and (max_distinct is None or capacity < max_distinct)):
+        raise ValueError(
+            f"dedupe_ids: capacity {capacity} < batch {b} needs a static "
+            f"bound (vocab or max_distinct <= capacity); got vocab={vocab}, "
+            f"max_distinct={max_distinct}"
+        )
+    return _dedupe_ids_impl(ids, capacity)
+
+
+def _dedupe_ids_impl(ids, capacity):
     # Single-sort formulation (measured 3.2x the jnp.unique + sort-method
     # searchsorted pipeline on v5e: 0.24 ms vs 0.78 ms at B=16384): one
     # payload sort ranks the ids, a cumsum over the first-occurrence mask
@@ -91,6 +124,9 @@ def dedupe_grads(
     # searchsorted(unique(clean), clean) would produce, so the segment_sum
     # is bit-identical to the textbook pipeline.  Unstable sorts are safe:
     # equal ids share a slot regardless of their relative order.
+    b = ids.shape[0]
+    oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
+    clean = jnp.where(ids >= 0, ids, oob)
     iota = jnp.arange(b, dtype=jnp.int32)
     sorted_ids, order = jax.lax.sort((clean, iota), num_keys=1, is_stable=False)
     first = jnp.concatenate(
@@ -99,15 +135,13 @@ def dedupe_grads(
     uidx = (jnp.cumsum(first) - 1).astype(jnp.int32)  # slot per sorted pos
     _, seg = jax.lax.sort((order, uidx), num_keys=1, is_stable=False)
     # slot s holds the id ranked s; slots past the distinct count keep the
-    # sentinel (and, when capacity < distinct — licensed by ``vocab`` only —
-    # the overflow writes/segments are dropped, never misdirected)
+    # sentinel (and, when capacity < distinct — licensed by a static bound
+    # only — the overflow writes/segments are dropped, never misdirected)
     uids = jnp.full((capacity,), oob, ids.dtype).at[uidx].set(
         sorted_ids, mode="drop"
     )
     valid = uids < oob
-    g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
-    g = jnp.where(valid[:, None], g, 0.0)
-    return uids, g, valid
+    return uids, seg, valid
 
 
 def _masked_scatter_rows(table: jax.Array, uids: jax.Array, new_rows: jax.Array,
@@ -240,6 +274,21 @@ def fat_adam_update(fat, count, ids, grads, *, embedding_dim, lr, b1=0.9,
     per row instead of 3 gathers + 3 scatters over separate table/mu/nu
     buffers.  Returns (fat, count).
     """
+    uids, g, valid = dedupe_grads(
+        ids.reshape(-1), grads.reshape(-1, grads.shape[-1]), capacity=capacity,
+        vocab=fat.shape[0], max_distinct=max_distinct,
+    )
+    return fat_adam_apply_unique(
+        fat, count, uids, g, embedding_dim=embedding_dim, lr=lr, b1=b1,
+        b2=b2, eps=eps, weight_decay=weight_decay,
+    )
+
+
+def fat_adam_apply_unique(fat, count, uids, g, *, embedding_dim, lr, b1=0.9,
+                          b2=0.999, eps=1e-8, weight_decay=0.0):
+    """:func:`fat_adam_update` on PRE-deduplicated ``(uids, g)`` — the
+    dedup-lookup path computes them once per step and shares them with the
+    forward's compact gather."""
     from tdfo_tpu.ops.pallas_kernels import (
         fat_adam_rows,
         fat_assemble,
@@ -247,10 +296,6 @@ def fat_adam_update(fat, count, ids, grads, *, embedding_dim, lr, b1=0.9,
     )
 
     d = embedding_dim
-    uids, g, valid = dedupe_grads(
-        ids.reshape(-1), grads.reshape(-1, grads.shape[-1]), capacity=capacity,
-        vocab=fat.shape[0], max_distinct=max_distinct,
-    )
     new_count = count + 1
     if jax.default_backend() == "tpu" and d <= 128:
         fat = fat_adam_rows(
@@ -318,6 +363,46 @@ class SparseOptimizer:
                 jnp.zeros((), jnp.int32),
             )
         raise ValueError(f"unknown sparse optimizer kind: {self.kind!r}")
+
+    def update_unique(self, table, slots, uids, g, valid, *,
+                      embedding_dim: int | None = None):
+        """Tier dispatch on PRE-deduplicated ``(uids, g, valid)`` — the
+        dedup-lookup step path (one shared sort per array per step).  The
+        small-vocab one-hot tier needs raw ids and is bypassed here;
+        ``sparse_adam`` has identical semantics."""
+        if table.ndim == 3:
+            if embedding_dim is None:
+                raise ValueError("fat-table update needs embedding_dim")
+            (count,) = slots
+            table, count = fat_adam_apply_unique(
+                table, count, uids, g, embedding_dim=embedding_dim,
+                lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
+                weight_decay=self.weight_decay,
+            )
+            return table, (count,)
+        if self.kind == "sgd":
+            return sparse_sgd(table, uids, g, valid, lr=self.lr,
+                              weight_decay=self.weight_decay), slots
+        if self.kind == "adagrad":
+            (accum,) = slots
+            table, accum = sparse_adagrad(
+                table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
+                weight_decay=self.weight_decay)
+            return table, (accum,)
+        if self.kind == "rowwise_adagrad":
+            (accum,) = slots
+            table, accum = sparse_rowwise_adagrad(
+                table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
+                weight_decay=self.weight_decay)
+            return table, (accum,)
+        if self.kind == "adam":
+            mu, nu, count = slots
+            table, mu, nu, count = sparse_adam(
+                table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
+                b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+            )
+            return table, (mu, nu, count)
+        raise ValueError(self.kind)
 
     def update(self, table, slots, ids, grads, *, embedding_dim: int | None = None,
                capacity: int | None = None, max_distinct: int | None = None):

@@ -372,6 +372,24 @@ class ShardedEmbeddingCollection:
             return int(array_name.removeprefix("__stack_"))
         return self.specs[array_name].embedding_dim
 
+    def needs_shard_map_update(self, array_name: str) -> bool:
+        """True when the array's sparse update must run inside an explicit
+        ``shard_map`` (fused fat storage + real row sharding: Pallas has no
+        GSPMD partitioning rule).  Public so the dedup-lookup step can route
+        such arrays through :meth:`sparse_update` and everything else through
+        the shared-dedupe ``update_unique`` fast path."""
+        if array_name in self._fat_groups:
+            shard_kind = self._fat_groups[array_name][0]
+            fused = array_name.startswith("__fatstack_")
+            row_sharded = shard_kind == "row"
+        elif array_name.startswith("__stack_"):
+            fused, row_sharded = False, True
+        else:
+            spec = self.specs[array_name]
+            fused, row_sharded = spec.fused, spec.sharding == "row"
+        return (fused and row_sharded
+                and self.mesh is not None and self.n_shards > 1)
+
     def sparse_update(self, opt, array_name: str, table, slots, ids, grads,
                       max_distinct: int | None = None):
         """Apply the row-sparse optimizer to one table, sharding-aware.
@@ -387,20 +405,7 @@ class ShardedEmbeddingCollection:
         Everything else routes straight to ``opt.update``.
         """
         d = self.array_embedding_dim(array_name)
-        if array_name in self._fat_groups:
-            shard_kind = self._fat_groups[array_name][0]
-            fused = array_name.startswith("__fatstack_")
-            row_sharded = shard_kind == "row"
-        elif array_name.startswith("__stack_"):
-            fused, row_sharded = False, True
-        else:
-            spec = self.specs[array_name]
-            fused, row_sharded = spec.fused, spec.sharding == "row"
-        needs_shard_map = (
-            fused and row_sharded
-            and self.mesh is not None and self.n_shards > 1
-        )
-        if not needs_shard_map:
+        if not self.needs_shard_map_update(array_name):
             return opt.update(table, slots, ids, grads, embedding_dim=d,
                               capacity=max_distinct, max_distinct=max_distinct)
 

@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from tdfo_tpu.ops.sparse import SparseOptimizer
+from tdfo_tpu.ops.sparse import SparseOptimizer, dedupe_ids
 from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
 
 __all__ = ["SparseTrainState", "make_sparse_train_step"]
@@ -66,6 +66,7 @@ def make_sparse_train_step(
     jit: bool = True,
     batch_transform: Callable | None = None,
     with_aux: bool = False,
+    dedup_lookup: bool = False,
 ):
     """Build the jitted hybrid step.
 
@@ -86,11 +87,40 @@ def make_sparse_train_step(
     ``with_aux=True``: ``forward`` must return ``(loss, aux)`` and the step
     returns ``(state, (loss, aux))`` — the hook for per-epoch TRAIN metrics
     (reference parity: train-side ROC-AUC, ``jax-flax/train_dp.py:219-220``).
+
+    ``dedup_lookup=True`` (requires ``mode="gspmd"``, non-negative ids): the
+    TBE unique-then-expand recipe.  Per table array, ONE sort deduplicates
+    the step's ids; the forward gathers only the unique rows (a compact,
+    cache-resident block — scattered gathers from a multi-GB table cost
+    ~40 ns/row on v5e, expands from the compact block ~2 ns/row) and the
+    backward segment-sums grads by the SAME mapping, feeding the optimizer
+    directly — no second dedupe.  Embeddings and updates are bit-identical
+    to the default path (same gather values, same segment construction);
+    measured ~25%% off the DLRM-Criteo step.  Arrays whose update needs the
+    explicit shard_map program (fused fat + real row sharding) keep the
+    default update path.
     """
     import inspect
 
+    if dedup_lookup and mode != "gspmd":
+        raise ValueError("dedup_lookup composes with lookup mode 'gspmd' only")
     features = list(coll.features())
     takes_rng = "dropout_rng" in inspect.signature(forward).parameters
+    by_table_static: dict[str, list[str]] = {}
+    for f in features:
+        by_table_static.setdefault(coll.resolve(f)[0], []).append(f)
+
+    def _concat_ids(feats, ids):
+        id_list, sizes, bound = [], [], 0
+        for f in feats:
+            _, spec, offset = coll.resolve(f)
+            flat = (ids[f] + offset).reshape(-1)
+            id_list.append(flat)
+            sizes.append(flat.shape[0])
+            # static per-feature distinct bound: a feature can touch at most
+            # min(its id count, its member vocab) rows
+            bound += min(flat.shape[0], spec.num_embeddings)
+        return jnp.concatenate(id_list), sizes, bound
 
     def step(state: SparseTrainState, batch, rng=None) -> tuple[SparseTrainState, jax.Array]:
         if batch_transform is not None:
@@ -106,7 +136,45 @@ def make_sparse_train_step(
                 return forward(dense_params, embs, batch, dropout_rng=step_rng)
             return forward(dense_params, embs, batch)
 
-        embs = coll.lookup(state.tables, ids, mode=mode)
+        dedup_ctx: dict[str, tuple] = {}
+        if dedup_lookup:
+            from tdfo_tpu.ops.pallas_kernels import fat_components
+
+            embs = {}
+            for tname, feats in by_table_static.items():
+                # column-sharded tables shard the EMBEDDING dim: the compact
+                # gather would drop the activation sharding the default
+                # lookup constrains — keep them on the default path (their
+                # update falls back too, since no ctx entry exists)
+                if (tname in coll.specs
+                        and coll.specs[tname].sharding == "column"):
+                    embs.update(coll.lookup(
+                        state.tables, {f: ids[f] for f in feats}, mode=mode))
+                    continue
+                table = state.tables[tname]
+                d = coll.array_embedding_dim(tname)
+                all_ids, sizes, bound = _concat_ids(feats, ids)
+                total = all_ids.shape[0]
+                # +1 slack: negative (padding) ids dedupe to ONE sentinel
+                # slot beyond the real-id bound; without it the expand would
+                # clamp the sentinel seg onto a real row's slot
+                cap = (-(-(bound + 1) // 8) * 8) if bound + 1 < total else None
+                uids, seg, valid = dedupe_ids(
+                    all_ids.astype(jnp.int32), capacity=cap, max_distinct=cap
+                )
+                rows = jnp.take(
+                    table, jnp.minimum(uids, table.shape[0] - 1), axis=0
+                )
+                if table.ndim == 3:  # fat rows: slice the table component
+                    rows = fat_components(rows, d)[0]
+                off = 0
+                for f, n_f in zip(feats, sizes):
+                    e = jnp.take(rows, seg[off:off + n_f], axis=0)
+                    embs[f] = e.reshape(*ids[f].shape, e.shape[-1])
+                    off += n_f
+                dedup_ctx[tname] = (uids, seg, valid)
+        else:
+            embs = coll.lookup(state.tables, ids, mode=mode)
         loss, (g_dense, g_embs) = jax.value_and_grad(
             loss_from_embs, argnums=(0, 1), has_aux=with_aux
         )(state.dense_params, embs)
@@ -121,22 +189,35 @@ def make_sparse_train_step(
         # sparse half: group features by table, one row-sparse update each
         new_tables = dict(state.tables)
         new_slots = dict(state.slots)
-        by_table: dict[str, list[str]] = {}
-        for f in features:
-            tname, _, _ = coll.resolve(f)
-            by_table.setdefault(tname, []).append(f)
-        for tname, feats in by_table.items():
-            id_list, grad_list = [], []
-            bound = 0
-            for f in feats:
-                _, spec, offset = coll.resolve(f)
-                id_list.append((ids[f] + offset).reshape(-1))
-                grad_list.append(g_embs[f].reshape(-1, g_embs[f].shape[-1]))
-                # static per-feature distinct bound: a feature can touch at
-                # most min(its id count, its member vocab) rows
-                bound += min(id_list[-1].shape[0], spec.num_embeddings)
-            all_ids = jnp.concatenate(id_list)
+        for tname, feats in by_table_static.items():
+            grad_list = [
+                g_embs[f].reshape(-1, g_embs[f].shape[-1]) for f in feats
+            ]
             all_grads = jnp.concatenate(grad_list)
+            # small-vocab adam tables keep the one-hot MXU tier (raw ids,
+            # no scatter — ~10x the per-row scatter formulation update_unique
+            # would fall back to)
+            small_adam = (
+                state.sparse_opt.kind == "adam"
+                and state.tables[tname].ndim == 2
+                and state.tables[tname].shape[0]
+                <= state.sparse_opt.small_vocab_threshold
+            )
+            if (tname in dedup_ctx and not small_adam
+                    and not coll.needs_shard_map_update(tname)):
+                # shared-dedupe fast path: segment-sum by the forward's seg
+                # and feed the optimizer tiers directly (no second sort)
+                uids, seg, valid = dedup_ctx[tname]
+                g_u = jax.ops.segment_sum(
+                    all_grads, seg, num_segments=uids.shape[0]
+                )
+                g_u = jnp.where(valid[:, None], g_u, 0.0)
+                new_tables[tname], new_slots[tname] = state.sparse_opt.update_unique(
+                    state.tables[tname], state.slots[tname], uids, g_u, valid,
+                    embedding_dim=coll.array_embedding_dim(tname),
+                )
+                continue
+            all_ids, _, bound = _concat_ids(feats, ids)
             # dedupe capacity = the proven bound when it is tighter than the
             # id count: scatter cost scales with SLOTS, so stacked many-table
             # arrays (e.g. DLRM-Criteo, where small tables are fully covered

@@ -405,6 +405,7 @@ class Trainer:
         inner = make_sparse_train_step(
             coll, ctr_sparse_forward(backbone, with_logits=True),
             mode=cfg.lookup_mode, jit=False, with_aux=True,
+            dedup_lookup=cfg.dedup_lookup,
         )
         if cfg.steps_per_execution > 1:
             self.train_step = _wrap_auc_multi_step(inner, donate_state=False)
@@ -501,6 +502,7 @@ class Trainer:
                 make_sparse_train_step(
                     self.coll, bert4rec_sparse_forward(self.backbone),
                     mode=cfg.lookup_mode, jit=False, batch_transform=transform,
+                    dedup_lookup=cfg.dedup_lookup,
                 ),
                 donate_state=False,
             )
@@ -508,6 +510,7 @@ class Trainer:
             self.train_step = make_sparse_train_step(
                 self.coll, bert4rec_sparse_forward(self.backbone),
                 mode=cfg.lookup_mode, donate=False, batch_transform=transform,
+                dedup_lookup=cfg.dedup_lookup,
             )
         self._train_auc_enabled = False  # AUC is a binary-CTR metric
         self._dropout_rng = jax.random.key(cfg.seed + 1)

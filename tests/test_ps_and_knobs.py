@@ -176,3 +176,34 @@ def test_stack_tables_knob(tmp_path):
     assert all(c.isalnum() or c == "_" for c in stacks[0]), stacks[0]
     tr_off = Trainer(read_configs(None, **common))
     assert not any(n.startswith("__tablestack_") for n in tr_off.state.tables)
+
+
+def test_dedup_lookup_knob(tmp_path):
+    """dedup_lookup=true trains the DMP regime with identical metrics to the
+    default path (the knob changes the schedule, not the math)."""
+    import numpy as np
+
+    from tdfo_tpu.core.config import read_configs
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.train.trainer import Trainer
+
+    d = tmp_path / "gr"
+    write_synthetic_goodreads(d, n_users=50, n_books=70,
+                              interactions_per_user=(12, 22), seed=23)
+    ctr = run_ctr_preprocessing(d)
+    common = dict(
+        data_dir=d, model="twotower", model_parallel=True, n_epochs=1,
+        learning_rate=3e-3, embed_dim=8, per_device_train_batch_size=16,
+        per_device_eval_batch_size=16, shuffle_buffer_size=200,
+        log_every_n_steps=1000, size_map=ctr,
+    )
+    m_on = Trainer(read_configs(None, dedup_lookup=True, **common)).fit()
+    m_off = Trainer(read_configs(None, **common)).fit()
+    for k in m_off:
+        assert np.isclose(m_on[k], m_off[k], rtol=1e-4, atol=1e-6), (k, m_on, m_off)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="gspmd"):
+        read_configs(None, dedup_lookup=True, lookup_mode="psum")

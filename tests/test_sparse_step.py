@@ -214,3 +214,70 @@ def test_fused_fat_table_sharded_update_matches_unsharded(mesh8):
     v_sh = coll_sh.lookup(tables_sh, {"item": ids})["item"]
     v_un = coll_un.lookup(tables_un, {"item": ids})["item"]
     np.testing.assert_allclose(np.asarray(v_sh), np.asarray(v_un), rtol=1e-6)
+
+
+def test_dedup_lookup_matches_default_path(mesh8):
+    """dedup_lookup=True (TBE unique-then-expand, shared sort between fwd
+    and update) must produce the SAME trajectory as the default path: same
+    gather values, same segment construction, same optimizer math."""
+    import optax
+
+    from tdfo_tpu.models.dlrm import DLRMBackbone, generic_embedding_specs
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+    from tdfo_tpu.train.ctr import ctr_sparse_forward
+
+    cats = ("c0", "c1", "c2")
+    conts = ("x0",)
+    sizes = {"c0": 50, "c1": 300, "c2": 7}
+    r = np.random.default_rng(11)
+
+    def run(dedup):
+        coll = ShardedEmbeddingCollection(
+            generic_embedding_specs(sizes, cats, 8, "row", fused_threshold=None),
+            mesh=mesh8, stack_tables=True,
+        )
+        bb = DLRMBackbone(embed_dim=8, cat_columns=cats, cont_columns=conts)
+        tables = coll.init(jax.random.key(0))
+        dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in cats}
+        dummy_c = {c: jnp.zeros((1,), jnp.float32) for c in conts}
+        state = SparseTrainState.create(
+            dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+            tx=optax.adam(1e-2),
+            tables=tables,
+            sparse_opt=sparse_optimizer("rowwise_adagrad", lr=1e-2),
+        )
+        step = make_sparse_train_step(
+            coll, ctr_sparse_forward(bb), donate=False, dedup_lookup=dedup
+        )
+        rr = np.random.default_rng(12)
+        losses = []
+        for _ in range(4):
+            batch = {c: jnp.asarray(rr.integers(0, sizes[c], 32), jnp.int32)
+                     for c in cats}
+            batch["x0"] = jnp.asarray(rr.random(32, dtype=np.float32))
+            batch["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+        return losses, state
+
+    l_def, s_def = run(False)
+    l_dd, s_dd = run(True)
+    np.testing.assert_allclose(l_dd, l_def, rtol=1e-6)
+    for n in s_def.tables:
+        np.testing.assert_allclose(
+            np.asarray(s_dd.tables[n]), np.asarray(s_def.tables[n]),
+            rtol=1e-6, atol=1e-7)
+
+
+def test_dedup_lookup_requires_gspmd():
+    import pytest
+
+    from tdfo_tpu.models.dlrm import generic_embedding_specs
+    from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+
+    coll = ShardedEmbeddingCollection(
+        generic_embedding_specs({"a": 10}, ("a",), 8, "replicated"))
+    with pytest.raises(ValueError, match="gspmd"):
+        make_sparse_train_step(coll, lambda d, e, b: 0.0, mode="psum",
+                               dedup_lookup=True)
