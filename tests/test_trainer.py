@@ -384,3 +384,21 @@ def test_preempted_save_does_not_poison_resume(prepared_dir, tmp_path):
     m = tr2.fit()  # resumes from epoch 0 and completes epoch 1
     assert 0.0 <= m["auc"] <= 1.0
     assert tr2._ckpt.latest_step() == 1
+
+
+def test_bert4rec_dedup_lookup_matches_default(prepared_dir):
+    """dedup_lookup on the sequence family ([B, T] ids, fat item table,
+    model-parallel mesh): same metrics as the default path."""
+    d, _, seq = prepared_dir
+    common = dict(
+        data_dir=d, model="bert4rec", model_parallel=True,
+        fused_table_threshold=8,  # fat item table
+        n_epochs=1, learning_rate=3e-3, embed_dim=16, n_heads=2, n_layers=1,
+        max_len=12, sliding_step=6, per_device_train_batch_size=8,
+        per_device_eval_batch_size=8, shuffle_buffer_size=1000,
+        log_every_n_steps=1000, size_map={"n_items": seq["n_items"]},
+    )
+    m_dd = Trainer(read_configs(None, dedup_lookup=True, **common)).fit()
+    m_def = Trainer(read_configs(None, **common)).fit()
+    for k in m_def:
+        assert np.isclose(m_dd[k], m_def[k], rtol=1e-4, atol=1e-6), (k, m_dd, m_def)
