@@ -241,10 +241,12 @@ def _make_criteo_host_batch(rng: np.random.Generator, b: int) -> dict[str, np.nd
 
 def build_criteo_train_bench(batch_size: int, embed_dim: int):
     """DLRM over the Criteo-Kaggle table profile (26 tables, 33.76M rows):
-    the BASELINE.json north-star metric measured directly.  Plain-table
-    STACKING puts all 26 tables in one array (one dedupe + one
-    gather/scatter per step); the rowwise-adagrad tier (fbgemm's huge-table
-    configuration) keeps optimizer state at one f32 per row.
+    the BASELINE.json north-star metric measured directly.  Big tables live
+    in ONE fused rowwise-adagrad fat-line stack (4 packed rows per 128-lane
+    line; in-place DMA kernel update — no XLA scatter in the step), small
+    tables in one plain 2D stack; dedup_lookup shares one sort between the
+    forward gather and the update (fbgemm fused-TBE parity, the huge-table
+    configuration: one f32 accumulator per row).
     """
     import jax
     import jax.numpy as jnp
@@ -263,10 +265,13 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
     cats = tuple(f"cat_{i}" for i in range(26))
     conts = tuple(f"cont_{i}" for i in range(13))
     size_map = {c: v for c, v in zip(cats, CRITEO_KAGGLE_VOCABS)}
+    # fused_threshold=0: EVERY table rides the fat-line stack, so the whole
+    # step contains no XLA scatter at all (one dedupe sort + one segment-sum
+    # + one in-place DMA kernel)
     coll = ShardedEmbeddingCollection(
         generic_embedding_specs(size_map, cats, embed_dim, "row",
-                                fused_threshold=None),
-        mesh=mesh, stack_tables=True,
+                                fused_threshold=0),
+        mesh=mesh, stack_tables=True, fused_kind="rowwise_adagrad",
     )
     # shapes only — the real tables are built INSIDE the jitted chain (a
     # per-chain constant the differencing cancels): an 8.65 GB table passed
@@ -321,10 +326,15 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
     flops_per_example = dense_flops_per_example(dense)
 
     def floor_bytes_fn() -> float:
-        # rowwise adagrad reads+writes table rows and the per-row accumulator
-        # cell: (2 x U x D + 2 x U) x 4B, plus the dense 6x AdamW sweep
+        # the fused update reads+writes packed 128-lane lines (table rows +
+        # accumulator cells together); best case every touched row shares
+        # its line fully -> w lanes x 4B x 2 directions per row.  Plus the
+        # dense 6x AdamW sweep.
+        from tdfo_tpu.ops.pallas_kernels import line_layout
+
+        lay = line_layout(embed_dim, "rowwise_adagrad")
         u_mean = float(np.mean(unique_rows_per_step)) if unique_rows_per_step else 0.0
-        return (2.0 * u_mean * embed_dim + 2.0 * u_mean) * 4.0 + 6.0 * dense_bytes
+        return 2.0 * u_mean * lay.w * 4.0 + 6.0 * dense_bytes
 
     return run, make_args, b, floor_bytes_fn, flops_per_example
 

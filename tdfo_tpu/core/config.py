@@ -139,8 +139,8 @@ class Config:
     # (fbgemm EmbOptimType parity: the reference picks ADAM on GPU and SGD on
     # CPU, torchrec/train.py:187-195).  "rowwise_adagrad" stores ONE f32
     # accumulator per row (fbgemm EXACT_ROWWISE_ADAGRAD, the >=1e9-row
-    # configuration); non-adam kinds disable fat-row fused storage (its
-    # packed moments are adam-specific).
+    # configuration).  Every kind composes with fat-line fused storage —
+    # the packed-line geometry adapts to the kind's state width.
     sparse_optimizer: str = "adam"
     # TBE unique-then-expand lookup (gspmd mode only): ONE sort per table
     # array per step deduplicates the ids; the forward gathers only unique
@@ -154,10 +154,12 @@ class Config:
     # gather/scatter per step instead of one per table.  Opt-in because it
     # changes checkpoint state keys.
     stack_tables: bool = False
-    # vocab size above which DMP-regime tables use fused fat-row storage
-    # (ops/pallas_kernels.fat_layout + the in-place DMA Adam kernel); smaller
-    # tables take the one-hot MXU update.  The kernel choice itself is
-    # automatic per backend — there is no "use pallas" switch to misconfigure.
+    # vocab size above which DMP-regime tables use fused fat-line storage
+    # (ops/pallas_kernels.line_layout + the in-place DMA update kernel,
+    # available for EVERY sparse_optimizer kind); smaller tables take the
+    # gather/scatter or one-hot MXU tiers.  0 fuses every table.  The kernel
+    # choice itself is automatic per backend — there is no "use pallas"
+    # switch to misconfigure.
     fused_table_threshold: int = 16384
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
@@ -244,11 +246,11 @@ class Config:
 
     @property
     def effective_fused_threshold(self) -> int | None:
-        """fused fat-row storage packs adam moments per row — any other
-        sparse optimizer kind disables it (one source of truth for both
-        model-family builders)."""
-        return (self.fused_table_threshold
-                if self.sparse_optimizer == "adam" else None)
+        """Vocab threshold for fused fat-line storage.  The packed line
+        geometry adapts to the optimizer kind
+        (``ops/pallas_kernels.line_layout``), so every sparse-optimizer
+        kind gets the fused in-place DMA update path."""
+        return self.fused_table_threshold
 
     @property
     def global_train_batch_size(self) -> int:

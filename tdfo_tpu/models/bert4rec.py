@@ -154,6 +154,7 @@ def make_sharded_bert4rec(
     dtype=jnp.float32,
     attn: str = "full",
     fused_threshold: int | None = 16384,
+    fused_kind: str = "adam",
     a2a_capacity_factor: float | None = None,
     ring_block_k: int | None = None,
     tp_heads: bool = False,
@@ -186,6 +187,7 @@ def make_sharded_bert4rec(
         ],
         mesh=mesh,
         a2a_capacity_factor=a2a_capacity_factor,
+        fused_kind=fused_kind,
     )
     k_table, k_dense = jax.random.split(rng)
     tables = coll.init(k_table)

@@ -31,13 +31,13 @@ import jax.numpy as jnp
 __all__ = [
     "dedupe_grads",
     "dedupe_ids",
-    "fat_adam_apply_unique",
+    "fat_apply_unique",
     "sparse_sgd",
     "sparse_adam",
     "sparse_adagrad",
     "sparse_rowwise_adagrad",
     "dense_lazy_adam",
-    "fat_adam_update",
+    "fat_update",
     "SparseOptimizer",
     "sparse_optimizer",
 ]
@@ -92,6 +92,7 @@ def dedupe_grads(
 def dedupe_ids(
     ids: jax.Array, *, capacity: int | None = None,
     vocab: int | None = None, max_distinct: int | None = None,
+    rows_per_line: int = 1,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """The id half of :func:`dedupe_grads`: ``ids[B] -> (uids[C], seg[B],
     valid[C])`` with ``ids == uids[seg]`` for non-negative ids.
@@ -102,20 +103,34 @@ def dedupe_ids(
     the SAME ``seg`` — one sort serves both directions instead of a dedupe
     in the update plus a full-width gather in the forward.  Capacity
     licensing matches :func:`dedupe_grads`.
+
+    ``rows_per_line`` > 1 (fat-line tables, ``pallas_kernels.line_layout``):
+    dedupe by LINE id instead of row id, AT NO EXTRA COST — the same single
+    sort yields the line grouping.  Returns ``(ulines[C], seg[B],
+    valid[C])`` where ``seg`` indexes the ``C x R`` line-slot space
+    (``seg = line_slot * R + row % R``): the forward gathers whole lines
+    and expands slot rows by ``seg``; the update segment-sums grads by the
+    SAME ``seg`` into exactly the kernel's packed operand layout.  Negative
+    ids map to slot 0 of the sentinel line (gathers row 0 after clamping —
+    identical to the default lookup's clip — and the kernel drops the
+    sentinel line's update).  ``capacity``/``vocab``/``max_distinct`` then
+    bound distinct LINES.
     """
     b = ids.shape[0]
     capacity = capacity or b
-    if (capacity < b and (vocab is None or capacity < vocab)
+    r = rows_per_line
+    vocab_bound = None if vocab is None else -(-vocab // r)
+    if (capacity < b and (vocab_bound is None or capacity < vocab_bound)
             and (max_distinct is None or capacity < max_distinct)):
         raise ValueError(
             f"dedupe_ids: capacity {capacity} < batch {b} needs a static "
             f"bound (vocab or max_distinct <= capacity); got vocab={vocab}, "
-            f"max_distinct={max_distinct}"
+            f"max_distinct={max_distinct}, rows_per_line={r}"
         )
-    return _dedupe_ids_impl(ids, capacity)
+    return _dedupe_ids_impl(ids, capacity, r)
 
 
-def _dedupe_ids_impl(ids, capacity):
+def _dedupe_ids_impl(ids, capacity, r: int = 1):
     # Single-sort formulation (measured 3.2x the jnp.unique + sort-method
     # searchsorted pipeline on v5e: 0.24 ms vs 0.78 ms at B=16384): one
     # payload sort ranks the ids, a cumsum over the first-occurrence mask
@@ -123,23 +138,26 @@ def _dedupe_ids_impl(ids, capacity):
     # carries the slot back to the original position.  ``seg`` equals what
     # searchsorted(unique(clean), clean) would produce, so the segment_sum
     # is bit-identical to the textbook pipeline.  Unstable sorts are safe:
-    # equal ids share a slot regardless of their relative order.
+    # equal ids share a slot regardless of their relative order.  With
+    # r > 1 the grouping key is the LINE id (ids are sorted, so line ids
+    # are too) and ``seg`` carries the line-slot index — the whole fat-line
+    # operand transform rides the same two sorts.
     b = ids.shape[0]
     oob = jnp.asarray(jnp.iinfo(ids.dtype).max, ids.dtype)
     clean = jnp.where(ids >= 0, ids, oob)
     iota = jnp.arange(b, dtype=jnp.int32)
     sorted_ids, order = jax.lax.sort((clean, iota), num_keys=1, is_stable=False)
-    first = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]]
-    )
-    uidx = (jnp.cumsum(first) - 1).astype(jnp.int32)  # slot per sorted pos
-    _, seg = jax.lax.sort((order, uidx), num_keys=1, is_stable=False)
-    # slot s holds the id ranked s; slots past the distinct count keep the
+    ok = sorted_ids < oob
+    key = jnp.where(ok, sorted_ids // r, oob) if r > 1 else sorted_ids
+    slot = jnp.where(ok, sorted_ids % r, 0) if r > 1 else None
+    first = jnp.concatenate([jnp.ones((1,), bool), key[1:] != key[:-1]])
+    uidx = (jnp.cumsum(first) - 1).astype(jnp.int32)  # group slot per sorted pos
+    segidx = uidx if r == 1 else uidx * r + slot
+    _, seg = jax.lax.sort((order, segidx), num_keys=1, is_stable=False)
+    # slot s holds the key ranked s; slots past the distinct count keep the
     # sentinel (and, when capacity < distinct — licensed by a static bound
     # only — the overflow writes/segments are dropped, never misdirected)
-    uids = jnp.full((capacity,), oob, ids.dtype).at[uidx].set(
-        sorted_ids, mode="drop"
-    )
+    uids = jnp.full((capacity,), oob, ids.dtype).at[uidx].set(key, mode="drop")
     valid = uids < oob
     return uids, seg, valid
 
@@ -260,61 +278,202 @@ def dense_lazy_adam(table, mu, nu, count, ids, grads, *, lr, b1=0.9, b2=0.999,
     )
 
 
-def fat_adam_update(fat, count, ids, grads, *, embedding_dim, lr, b1=0.9,
-                    b2=0.999, eps=1e-8, weight_decay=0.0,
-                    capacity: int | None = None,
-                    max_distinct: int | None = None):
-    """Big-table tier: fused lazy Adam over fat rows ``[V, T, 128]``
-    (``pallas_kernels.fat_layout``: table | mu | nu packed per row).
+def _lines_from_unique(uids, g, valid, layout):
+    """Row-level uniques -> line-level kernel operands.
 
-    On TPU with d <= 128 this runs the in-place DMA kernel
-    (:func:`~tdfo_tpu.ops.pallas_kernels.fat_adam_rows`); elsewhere an XLA
-    formulation with ONE full-row gather and ONE full-row scatter — fat rows
-    exist precisely so the whole read-modify-write is a single descriptor
-    per row instead of 3 gathers + 3 scatters over separate table/mu/nu
-    buffers.  Returns (fat, count).
+    ``uids`` arrive SORTED ascending with sentinels (int32 max) grouped at
+    the top (the :func:`dedupe_grads` contract), so their line ids are also
+    sorted — a first-occurrence mask + cumsum assigns line slots WITHOUT a
+    second sort.  Returns ``(ulines[C], g_slots[C, R, d], touched[C, R])``
+    where C is the row capacity (an upper bound on distinct lines; surplus
+    slots carry the sentinel and the kernel skips their DMAs entirely).
     """
-    uids, g, valid = dedupe_grads(
-        ids.reshape(-1), grads.reshape(-1, grads.shape[-1]), capacity=capacity,
-        vocab=fat.shape[0], max_distinct=max_distinct,
-    )
-    return fat_adam_apply_unique(
-        fat, count, uids, g, embedding_dim=embedding_dim, lr=lr, b1=b1,
-        b2=b2, eps=eps, weight_decay=weight_decay,
-    )
+    r = layout.r
+    cap = uids.shape[0]
+    oob = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    uids = uids.astype(jnp.int32)
+    line = jnp.where(valid, uids // r, oob)
+    slot = jnp.where(valid, uids % r, 0)
+    first = jnp.concatenate([jnp.ones((1,), bool), line[1:] != line[:-1]])
+    lidx = (jnp.cumsum(first) - 1).astype(jnp.int32)
+    ulines = jnp.full((cap,), oob, jnp.int32).at[lidx].set(line, mode="drop")
+    # all sentinel rows share one line id -> one slot, which stays oob
+    seg2 = jnp.where(valid, lidx * r + slot, cap * r)  # invalid -> dropped
+    g_slots = jax.ops.segment_sum(
+        g.astype(jnp.float32), seg2, num_segments=cap * r
+    ).reshape(cap, r, -1)
+    touched = (jax.ops.segment_sum(
+        valid.astype(jnp.float32), seg2, num_segments=cap * r
+    ) > 0).astype(jnp.float32).reshape(cap, r)
+    return ulines, g_slots, touched
 
 
-def fat_adam_apply_unique(fat, count, uids, g, *, embedding_dim, lr, b1=0.9,
-                          b2=0.999, eps=1e-8, weight_decay=0.0):
-    """:func:`fat_adam_update` on PRE-deduplicated ``(uids, g)`` — the
-    dedup-lookup path computes them once per step and shares them with the
-    forward's compact gather."""
-    from tdfo_tpu.ops.pallas_kernels import (
-        fat_adam_rows,
-        fat_assemble,
-        fat_components,
-    )
-
-    d = embedding_dim
-    new_count = count + 1
-    if jax.default_backend() == "tpu" and d <= 128:
-        fat = fat_adam_rows(
-            fat, uids, g, new_count, d=d, lr=lr, b1=b1, b2=b2, eps=eps,
-            weight_decay=weight_decay,
+def _pack_lanes(g_slots, touched, layout):
+    """[C, R, d] grads + [C, R] touched -> [C, T, 128] packed-lane operands
+    (grads at table lanes, zeros elsewhere; touched broadcast slot-wide)."""
+    cap, r, d = g_slots.shape
+    gp = g_slots
+    if layout.w > d:
+        gp = jnp.concatenate(
+            [gp, jnp.zeros((cap, r, layout.w - d), jnp.float32)], axis=-1
         )
-        return fat, new_count
-    # XLA fallback (CPU tests, d > 128): numerically identical
-    rows = jnp.take(fat, jnp.minimum(uids, fat.shape[0] - 1), axis=0)  # [U, T, 128]
-    row, mu_r, nu_r = fat_components(rows, d)
-    t = new_count.astype(jnp.float32)
-    corr = jnp.stack([1.0 - b1**t, 1.0 - b2**t])
-    mu_n = b1 * mu_r + (1 - b1) * g.astype(jnp.float32)
-    nu_n = b2 * nu_r + (1 - b2) * g.astype(jnp.float32) ** 2
-    delta = lr * ((mu_n / corr[0]) / (jnp.sqrt(nu_n / corr[1]) + eps)
-                  + weight_decay * row)
-    new_rows = fat_assemble(rows, (row - delta, mu_n, nu_n), d)
-    # sentinel uids are out of bounds -> dropped by the scatter
-    return fat.at[uids].set(new_rows, mode="drop"), new_count
+    gp = gp.reshape(cap, layout.tiles, 128)
+    tl = jnp.broadcast_to(
+        touched[:, :, None], (cap, r, layout.w)
+    ).reshape(cap, layout.tiles, 128)
+    return gp, tl
+
+
+def _fat_apply_lines_xla(fat, ulines, g_slots, touched, *, layout, lr, b1,
+                         b2, eps, weight_decay, new_count=None):
+    """Portable line-level formulation: gather every slot row of the
+    touched lines through the [L*R, W] view, apply the per-row optimizer
+    math (bit-identical to the plain-table ``sparse_*`` functions) gated by
+    ``touched``, scatter back.  CPU/test path; the TPU path is the in-place
+    DMA kernel."""
+    from tdfo_tpu.ops.pallas_kernels import fat_view
+
+    d, r = layout.d, layout.r
+    n_lines = fat.shape[0]
+    view = fat_view(fat, layout)
+    # sentinel lines (int32 max) redirect past the view: gather clamps
+    # (values unused — touched is 0 there), scatter drops
+    base = jnp.where(ulines < n_lines, ulines, n_lines).astype(jnp.int32)
+    idx = (base[:, None] * r + jnp.arange(r, dtype=jnp.int32)[None, :]).reshape(-1)
+    rows_full = jnp.take(view, jnp.minimum(idx, view.shape[0] - 1), axis=0)
+    table = rows_full[:, :d]
+    g = g_slots.astype(jnp.float32)
+    kind = layout.kind
+    if kind == "sgd":
+        g2 = g + weight_decay * table
+        parts = {0: table - lr * g2}
+    elif kind == "rowwise_adagrad":
+        acc = rows_full[:, d]
+        g2 = g + weight_decay * table
+        acc_n = acc + jnp.mean(g2 * g2, axis=-1)
+        delta = lr * g2 / (jnp.sqrt(acc_n)[:, None] + eps)
+        parts = {0: table - delta, d: acc_n[:, None]}
+    elif kind == "adagrad":
+        acc = rows_full[:, d:2 * d]
+        g2 = g + weight_decay * table
+        acc_n = acc + g2 * g2
+        delta = lr * g2 / (jnp.sqrt(acc_n) + eps)
+        parts = {0: table - delta, d: acc_n}
+    else:  # adam
+        mu, nu = rows_full[:, d:2 * d], rows_full[:, 2 * d:3 * d]
+        t = new_count.astype(jnp.float32)
+        mu_n = b1 * mu + (1 - b1) * g
+        nu_n = b2 * nu + (1 - b2) * g * g
+        mu_hat = mu_n / (1 - b1**t)
+        nu_hat = nu_n / (1 - b2**t)
+        delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * table)
+        parts = {0: table - delta, d: mu_n, 2 * d: nu_n}
+    new_rows = rows_full
+    for off, comp in parts.items():
+        new_rows = jax.lax.dynamic_update_slice_in_dim(new_rows, comp, off, axis=1)
+    new_rows = jnp.where(touched.reshape(-1)[:, None] > 0, new_rows, rows_full)
+    return view.at[idx].set(new_rows, mode="drop").reshape(fat.shape)
+
+
+def _fat_apply_lines(fat, slots, ulines, g_slots, touched, *, layout, lr,
+                     b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                     interpret: bool = False):
+    """Shared line-level dispatch: kernel on TPU (or interpret), XLA
+    formulation elsewhere.  ``g_slots``: [C*R, d] summed grads in line-slot
+    order; ``touched``: [C*R] occupancy (any dtype, > 0 = touched).
+    Returns ``(fat, slots)``."""
+    from tdfo_tpu.ops.pallas_kernels import fat_line_update
+
+    kind = layout.kind
+    if kind == "adam":
+        (count,) = slots
+        new_count = count + 1
+        t = new_count.astype(jnp.float32)
+        corr = jnp.stack([1.0 - b1**t, 1.0 - b2**t])
+        new_slots = (new_count,)
+    else:
+        new_count = None
+        corr = jnp.zeros((2,), jnp.float32)
+        new_slots = slots
+    c = ulines.shape[0]
+    g_slots = g_slots.reshape(c, layout.r, -1)
+    touched_f = (touched.reshape(c, layout.r) > 0).astype(jnp.float32)
+    # d > 128 lines span 4+ tiles — rare configs with no on-chip coverage;
+    # keep them on the proven XLA formulation (the pre-existing guard)
+    if layout.d <= 128 and (jax.default_backend() == "tpu" or interpret):
+        gp, tl = _pack_lanes(g_slots.astype(jnp.float32), touched_f, layout)
+        fat = fat_line_update(
+            fat, ulines, gp, tl, corr, layout=layout, lr=lr, b1=b1, b2=b2,
+            eps=eps, weight_decay=weight_decay, interpret=interpret,
+        )
+    else:
+        fat = _fat_apply_lines_xla(
+            fat, ulines, g_slots.reshape(c * layout.r, -1), touched_f,
+            layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
+            weight_decay=weight_decay, new_count=new_count,
+        )
+    return fat, new_slots
+
+
+def fat_apply_unique(fat, slots, uids, g, valid=None, *, embedding_dim, kind,
+                     lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                     interpret: bool = False):
+    """Fused fat-line optimizer step on PRE-deduplicated row-level
+    ``(uids, g)``.  ``uids`` must be sorted ascending with int32-max
+    sentinels at the top (the :func:`dedupe_grads` layout) — the line
+    grouping then needs no extra sort.  Returns ``(fat, slots)``.
+
+    Prefer the line-level path (``dedupe_ids(rows_per_line=R)`` +
+    ``SparseOptimizer.update_unique_lines``) in hot steps: it skips the
+    row->line scatters entirely.
+    """
+    from tdfo_tpu.ops.pallas_kernels import line_layout
+
+    layout = line_layout(embedding_dim, kind)
+    if valid is None:
+        valid = uids < jnp.iinfo(jnp.int32).max
+    ulines, g_slots, touched = _lines_from_unique(uids, g, valid, layout)
+    return _fat_apply_lines(
+        fat, slots, ulines, g_slots.reshape(-1, g_slots.shape[-1]),
+        touched.reshape(-1), layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, interpret=interpret,
+    )
+
+
+def fat_update(fat, slots, ids, grads, *, embedding_dim, kind, lr, b1=0.9,
+               b2=0.999, eps=1e-8, weight_decay=0.0,
+               capacity: int | None = None, max_distinct: int | None = None,
+               interpret: bool = False):
+    """Big-table tier: fused in-backward optimizer over packed fat lines
+    (``pallas_kernels.line_layout``) — fbgemm TBE parity for every
+    ``EmbOptimType`` kind the framework exposes (adam / sgd / adagrad /
+    rowwise_adagrad; ``torchrec/train.py:187-195``).
+
+    One line-aware dedupe sort + one segment-sum produce the kernel
+    operands directly (no row-level intermediate).  ``capacity`` /
+    ``max_distinct`` bound distinct LINES here (a row bound is always a
+    valid line bound).  Returns ``(fat, slots)``."""
+    from tdfo_tpu.ops.pallas_kernels import line_layout
+
+    layout = line_layout(embedding_dim, kind)
+    r = layout.r
+    ids = ids.reshape(-1)
+    grads = grads.reshape(-1, grads.shape[-1])
+    ulines, seg, valid = dedupe_ids(
+        ids, capacity=capacity, vocab=fat.shape[0] * r,
+        max_distinct=max_distinct, rows_per_line=r,
+    )
+    c = ulines.shape[0]
+    g_slots = jax.ops.segment_sum(
+        grads.astype(jnp.float32), seg, num_segments=c * r
+    )
+    touched = jax.ops.segment_sum(
+        (ids >= 0).astype(jnp.float32), seg, num_segments=c * r
+    )
+    return _fat_apply_lines(
+        fat, slots, ulines, g_slots, touched, layout=layout, lr=lr, b1=b1,
+        b2=b2, eps=eps, weight_decay=weight_decay, interpret=interpret,
+    )
 
 
 @dataclass(frozen=True)
@@ -323,15 +482,15 @@ class SparseOptimizer:
 
     The KeyedOptimizerWrapper/CombinedOptimizer equivalent for the sparse half
     (``torchrec/train.py:248-254``): dense params keep optax; each embedding
-    table gets one of these.  Adam dispatches across three tiers picked for
+    table gets one of these.  Updates dispatch across three tiers picked for
     TPU cost structure (measured on v5e — XLA scatter serialises per row, so
     scatter-free formulations win):
 
-      * fat storage (``table.ndim == 3``): in-place DMA kernel / single
-        row-granular gather+scatter — O(touched rows) traffic on tables of
-        any size (the >=1B-row path);
-      * plain storage, small vocab (<= ``small_vocab_threshold``): one-hot
-        MXU matmul + dense masked sweep, no sort/gather/scatter at all;
+      * fat-line storage (``table.ndim == 3``, ANY kind): in-place DMA
+        kernel on packed lines — O(touched rows) traffic on tables of any
+        size (the >=1B-row path, fbgemm fused-TBE parity);
+      * plain storage, small vocab (<= ``small_vocab_threshold``, adam):
+        one-hot MXU matmul + dense masked sweep, no sort/gather/scatter;
       * plain storage, large vocab: dedupe + row gather/scatter (the
         portable XLA formulation).
     """
@@ -345,10 +504,10 @@ class SparseOptimizer:
     small_vocab_threshold: int = 16384
 
     def init(self, table: jax.Array) -> Any:
-        if table.ndim == 3:  # fat rows carry their own moments
-            if self.kind != "adam":
-                raise ValueError("fat (fused) tables require the adam optimizer")
-            return (jnp.zeros((), jnp.int32),)
+        if table.ndim == 3:  # fat lines carry their own optimizer state
+            # adam keeps the global step count for bias correction; the
+            # other kinds are fully self-contained in the packed rows
+            return (jnp.zeros((), jnp.int32),) if self.kind == "adam" else ()
         if self.kind == "sgd":
             return ()
         if self.kind == "adagrad":
@@ -364,6 +523,22 @@ class SparseOptimizer:
             )
         raise ValueError(f"unknown sparse optimizer kind: {self.kind!r}")
 
+    def update_unique_lines(self, table, slots, ulines, g_slots, touched, *,
+                            embedding_dim: int):
+        """Fat-line fast path on line-level operands from
+        ``dedupe_ids(rows_per_line=R)`` — the dedup-lookup step shares ONE
+        sort between the forward's line gather and this update."""
+        from tdfo_tpu.ops.pallas_kernels import line_layout
+
+        if table.ndim != 3:
+            raise ValueError("update_unique_lines is the fat-line path")
+        return _fat_apply_lines(
+            table, slots, ulines, g_slots, touched,
+            layout=line_layout(embedding_dim, self.kind), lr=self.lr,
+            b1=self.b1, b2=self.b2, eps=self.eps,
+            weight_decay=self.weight_decay,
+        )
+
     def update_unique(self, table, slots, uids, g, valid, *,
                       embedding_dim: int | None = None):
         """Tier dispatch on PRE-deduplicated ``(uids, g, valid)`` — the
@@ -373,13 +548,11 @@ class SparseOptimizer:
         if table.ndim == 3:
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
-            (count,) = slots
-            table, count = fat_adam_apply_unique(
-                table, count, uids, g, embedding_dim=embedding_dim,
-                lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
-                weight_decay=self.weight_decay,
+            return fat_apply_unique(
+                table, slots, uids, g, valid, embedding_dim=embedding_dim,
+                kind=self.kind, lr=self.lr, b1=self.b1, b2=self.b2,
+                eps=self.eps, weight_decay=self.weight_decay,
             )
-            return table, (count,)
         if self.kind == "sgd":
             return sparse_sgd(table, uids, g, valid, lr=self.lr,
                               weight_decay=self.weight_decay), slots
@@ -409,14 +582,12 @@ class SparseOptimizer:
         if table.ndim == 3:
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
-            (count,) = slots
-            table, count = fat_adam_update(
-                table, count, ids, grads, embedding_dim=embedding_dim,
-                lr=self.lr, b1=self.b1, b2=self.b2, eps=self.eps,
-                weight_decay=self.weight_decay, capacity=capacity,
-                max_distinct=max_distinct,
+            return fat_update(
+                table, slots, ids, grads, embedding_dim=embedding_dim,
+                kind=self.kind, lr=self.lr, b1=self.b1, b2=self.b2,
+                eps=self.eps, weight_decay=self.weight_decay,
+                capacity=capacity, max_distinct=max_distinct,
             )
-            return table, (count,)
         if self.kind == "adam" and table.shape[0] <= self.small_vocab_threshold:
             mu, nu, count = slots
             table, mu, nu, count = dense_lazy_adam(

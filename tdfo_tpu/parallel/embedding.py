@@ -96,11 +96,13 @@ class EmbeddingSpec:
     # uniform(-init_scale, init_scale); torchrec weight_init_min/max = -1/1
     init_scale: float = 1.0
     dtype: jnp.dtype = jnp.float32
-    # fused in-backward Adam storage: the table lives as fat rows
-    # [V, T, 128] carrying [table | mu | nu] per row
-    # (ops/pallas_kernels.fat_layout) so the optimizer read-modify-writes one
-    # aligned DMA descriptor per touched row — the fbgemm-TBE-equivalent
-    # layout that makes O(batch) updates fast on TPU.  f32 only.
+    # fused in-backward optimizer storage: the table lives as packed fat
+    # lines [L, T, 128] carrying [table | optimizer state] per vocab row
+    # (ops/pallas_kernels.line_layout, geometry set by the collection's
+    # fused_kind) so the optimizer read-modify-writes one aligned DMA
+    # descriptor per touched line — the fbgemm-TBE-equivalent layout that
+    # makes O(batch) updates fast on TPU for every EmbOptimType kind
+    # (adam / sgd / adagrad / rowwise_adagrad).  f32 only.
     fused: bool = False
 
     def feature_names(self) -> tuple[str, ...]:
@@ -127,6 +129,7 @@ class ShardedEmbeddingCollection:
         axis: str = MODEL_AXIS,
         a2a_capacity_factor: float | None = None,
         stack_tables: bool = False,
+        fused_kind: str = "adam",
     ):
         """``a2a_capacity_factor``: per-shard send-bucket capacity for the
         alltoall lookup program, as a multiple of the balanced share
@@ -141,7 +144,18 @@ class ShardedEmbeddingCollection:
         analogue of the always-on fat stacking, so a many-table model
         (DLRM-Criteo: 26 tables) pays ONE dedupe + ONE gather/scatter per
         step instead of one per table.  Opt-in because it changes the state
-        pytree layout (checkpoint keys)."""
+        pytree layout (checkpoint keys).
+
+        ``fused_kind``: the sparse-optimizer kind whose state the fused
+        fat-line storage packs per row (``pallas_kernels.line_layout``) —
+        it determines the line geometry, so it must match the
+        ``SparseOptimizer`` used by the train step (fbgemm's TBE likewise
+        bakes the optimizer into the table storage,
+        ``torchrec/train.py:241-247``)."""
+        from tdfo_tpu.ops.pallas_kernels import line_layout
+
+        self.fused_kind = fused_kind
+        line_layout(1, fused_kind)  # validates the kind eagerly
         self.specs = {s.name: s for s in specs}
         if len(self.specs) != len(specs):
             raise ValueError("duplicate table names")
@@ -198,8 +212,12 @@ class ShardedEmbeddingCollection:
                 gname = (f"{prefix}{dim}_{shard_kind}" if fused
                          else f"{prefix}{dim}_{shard_kind}_{dt}")
                 total = sum(s.num_embeddings for s in group)
+                # fused stacks additionally round to whole LINES so shard
+                # boundaries never split a packed line
+                unit = self.fat_layout(dim).r if fused else 1
                 if shard_kind == "row":
-                    total = _round_up(total, self.n_shards)
+                    unit *= self.n_shards
+                total = _round_up(total, unit)
                 off = 0
                 for s in group:
                     self._stack_rows[s.name] = (off, total)
@@ -247,6 +265,16 @@ class ShardedEmbeddingCollection:
 
     # ---------------------------------------------------------------- init
 
+    def fat_layout(self, d: int):
+        """Packed-line geometry of fused storage at embedding dim ``d``
+        under this collection's ``fused_kind``."""
+        from tdfo_tpu.ops.pallas_kernels import line_layout
+
+        return line_layout(d, self.fused_kind)
+
+    def fat_layout_for(self, array_name: str):
+        return self.fat_layout(self.array_embedding_dim(array_name))
+
     def table_sharding(self, spec: EmbeddingSpec) -> NamedSharding | None:
         if self.mesh is None:
             return None
@@ -277,8 +305,10 @@ class ShardedEmbeddingCollection:
             if spec.sharding == "table" or name in fat_members:
                 continue
             rows = spec.num_embeddings
+            unit = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
             if spec.sharding == "row":
-                rows = _round_up(rows, self.n_shards)
+                unit *= self.n_shards
+            rows = _round_up(rows, unit)
             dim = spec.embedding_dim
             if spec.sharding == "column" and dim % self.n_shards:
                 raise ValueError(
@@ -292,8 +322,8 @@ class ShardedEmbeddingCollection:
             if spec.fused:
                 from tdfo_tpu.ops.pallas_kernels import fat_pack
 
-                z = jnp.zeros_like(t, dtype=jnp.float32)
-                t = fat_pack(t, z, z)  # [rows, T, 128]: moments start at zero
+                # [lines, T, 128]: optimizer state starts at zero
+                t = fat_pack(t, kind=self.fused_kind)
             sh = self.table_sharding(spec)
             tables[name] = jax.device_put(t, sh) if sh is not None else t
         def assemble_stack(group, key, dtype):
@@ -320,8 +350,7 @@ class ShardedEmbeddingCollection:
                 from tdfo_tpu.ops.pallas_kernels import fat_pack
 
                 t = assemble_stack(group, next(key_iter), jnp.float32)
-                z = jnp.zeros_like(t)
-                arr = fat_pack(t, z, z)  # [total, T, 128]
+                arr = fat_pack(t, kind=self.fused_kind)  # [lines, T, 128]
             else:  # plain 2D table stack (stack_tables=True)
                 arr = assemble_stack(group, next(key_iter), group[0].dtype)
             if self.mesh is not None:
@@ -410,15 +439,17 @@ class ShardedEmbeddingCollection:
                               capacity=max_distinct, max_distinct=max_distinct)
 
         from tdfo_tpu.core.mesh import DATA_AXIS
-        from tdfo_tpu.ops.sparse import fat_adam_update
+        from tdfo_tpu.ops.sparse import fat_update
 
         axis = self.axis
-        (count,) = slots
-        rows_per_shard = table.shape[0] // self.n_shards
+        kind = self.fused_kind
+        # table.shape[0] counts LINES; shards own whole lines (init rounds
+        # rows to n_shards x R), so each shard covers lines x R vocab rows
+        rows_per_shard = (table.shape[0] // self.n_shards) * self.fat_layout(d).r
         ids_flat = ids.reshape(-1)
         grads_flat = grads.reshape(-1, grads.shape[-1])
 
-        def local(fat_shard, count, ids_local, grads_local):
+        def local(fat_shard, slots_in, ids_local, grads_local):
             ids_all = jax.lax.all_gather(ids_local, DATA_AXIS, tiled=True)
             g_all = jax.lax.all_gather(grads_local, DATA_AXIS, tiled=True)
             k = jax.lax.axis_index(axis)
@@ -428,24 +459,24 @@ class ShardedEmbeddingCollection:
             # dropped sentinel; their (zeroed) grads contribute nothing
             masked = jnp.where(mine, local_ids, -1)
             g_masked = jnp.where(mine[:, None], g_all, 0.0)
-            new_fat, new_count = fat_adam_update(
-                fat_shard, count, masked, g_masked, embedding_dim=d,
-                lr=opt.lr, b1=opt.b1, b2=opt.b2, eps=opt.eps,
+            return fat_update(
+                fat_shard, slots_in, masked, g_masked, embedding_dim=d,
+                kind=kind, lr=opt.lr, b1=opt.b1, b2=opt.b2, eps=opt.eps,
                 weight_decay=opt.weight_decay,
                 capacity=max_distinct, max_distinct=max_distinct,
             )
-            return new_fat, new_count
 
         mesh = self.mesh
         fat_spec = P(axis, None, None)
-        new_table, new_count = jax.shard_map(
+        slots_spec = tuple(P() for _ in slots)
+        new_table, new_slots = jax.shard_map(
             local,
             mesh=mesh,
-            in_specs=(fat_spec, P(), P(DATA_AXIS), P(DATA_AXIS, None)),
-            out_specs=(fat_spec, P()),
+            in_specs=(fat_spec, slots_spec, P(DATA_AXIS), P(DATA_AXIS, None)),
+            out_specs=(fat_spec, slots_spec),
             check_vma=False,
-        )(table, count, ids_flat, grads_flat)
-        return new_table, (new_count,)
+        )(table, slots, ids_flat, grads_flat)
+        return new_table, new_slots
 
     def lookup(
         self,
@@ -460,15 +491,20 @@ class ShardedEmbeddingCollection:
             tname, spec, offset = self.resolve(feat)
             table = tables[tname]
             if mode == "gspmd" or self.mesh is None or spec.sharding in ("replicated",):
-                # fused tables gather FULL fat rows then slice out the table
-                # component — a narrow (1, d)-slice gather from fat rows is
-                # pathologically slow on TPU (measured 100x+ worse), while
-                # the full-row gather matches a plain [V, d] gather.
-                vecs = jnp.take(table, ids + offset, axis=0)
                 if spec.fused:
-                    from tdfo_tpu.ops.pallas_kernels import fat_components
+                    # gather FULL packed lines off the 3D array (one fast
+                    # 512B descriptor per id — reshaping the table to a row
+                    # view would materialise a multi-GB copy under TPU
+                    # tiled layouts), then slot-select the table lanes on
+                    # the small gathered block.
+                    from tdfo_tpu.ops.pallas_kernels import fat_gather_rows
 
-                    vecs = fat_components(vecs, spec.embedding_dim)[0]
+                    vecs = fat_gather_rows(
+                        table, ids + offset,
+                        self.fat_layout(spec.embedding_dim),
+                    )
+                else:
+                    vecs = jnp.take(table, ids + offset, axis=0)
                 if self.mesh is not None and spec.sharding == "column":
                     vecs = jax.lax.with_sharding_constraint(
                         vecs, NamedSharding(self.mesh, P(*([None] * ids.ndim), self.axis))
@@ -490,15 +526,22 @@ class ShardedEmbeddingCollection:
             out[feat] = vecs
         return out
 
-    def _extractor(self, spec: EmbeddingSpec):
-        """Row post-processing for explicit-collective programs: fused tables
-        yield fat rows whose table component must be sliced out BEFORE the
-        collective (also shrinks the bytes on the wire by 3-6x)."""
+    def _local_gather(self, spec: EmbeddingSpec):
+        """(table_shard, vocab-row idx) -> [.., d] gather for the explicit
+        collective programs, fused-aware: packed shards line-gather +
+        slot-select the table lanes BEFORE the collective (also shrinks the
+        bytes on the wire 2-8x vs shipping whole lines)."""
         if not spec.fused:
-            return lambda rows: rows
-        from tdfo_tpu.ops.pallas_kernels import fat_components
+            return lambda shard, idx: jnp.take(shard, idx, axis=0)
+        from tdfo_tpu.ops.pallas_kernels import fat_gather_rows
 
-        return lambda rows: fat_components(rows, spec.embedding_dim)[0]
+        lay = self.fat_layout(spec.embedding_dim)
+        return lambda shard, idx: fat_gather_rows(shard, idx, lay)
+
+    def _rows_per_shard(self, table: jax.Array, spec: EmbeddingSpec) -> int:
+        """Vocab rows per model-axis shard (fat shards count lines x R)."""
+        mult = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
+        return (table.shape[0] // self.n_shards) * mult
 
     def _lookup_psum(self, table: jax.Array, ids: jax.Array,
                      spec: EmbeddingSpec) -> jax.Array:
@@ -510,17 +553,17 @@ class ShardedEmbeddingCollection:
         """
         mesh = self.mesh
         axis = self.axis
-        rows_per_shard = table.shape[0] // self.n_shards
-        extract = self._extractor(spec)
+        rows_per_shard = self._rows_per_shard(table, spec)
+        gather_rows = self._local_gather(spec)
 
         def local(table_shard, ids_local):
             idx = jax.lax.axis_index(axis)
             start = idx * rows_per_shard
             local_ids = ids_local - start
             mine = (local_ids >= 0) & (local_ids < rows_per_shard)
-            gathered = extract(jnp.take(
-                table_shard, jnp.clip(local_ids, 0, rows_per_shard - 1), axis=0
-            ))
+            gathered = gather_rows(
+                table_shard, jnp.clip(local_ids, 0, rows_per_shard - 1)
+            )
             gathered = jnp.where(mine[..., None], gathered, 0)
             return jax.lax.psum(gathered, axis)
 
@@ -556,8 +599,8 @@ class ShardedEmbeddingCollection:
         mesh = self.mesh
         axis = self.axis
         m = self.n_shards
-        rows_per_shard = table.shape[0] // m
-        extract = self._extractor(spec)
+        rows_per_shard = self._rows_per_shard(table, spec)
+        gather_rows = self._local_gather(spec)
         cf = self.a2a_capacity_factor
 
         def local(table_shard, ids_local):
@@ -591,9 +634,9 @@ class ShardedEmbeddingCollection:
             recv_ids = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)
             local_idx = recv_ids - jax.lax.axis_index(axis) * rows_per_shard
             valid = recv_ids >= 0
-            gathered = extract(jnp.take(
-                table_shard, jnp.clip(local_idx, 0, rows_per_shard - 1), axis=0
-            ))
+            gathered = gather_rows(
+                table_shard, jnp.clip(local_idx, 0, rows_per_shard - 1)
+            )
             gathered = jnp.where(valid[..., None], gathered, 0)
             # send vectors back to requesters
             back = jax.lax.all_to_all(gathered, axis, split_axis=0, concat_axis=0)

@@ -110,7 +110,7 @@ def make_sparse_train_step(
     for f in features:
         by_table_static.setdefault(coll.resolve(f)[0], []).append(f)
 
-    def _concat_ids(feats, ids):
+    def _concat_ids(feats, ids, rows_per_line: int = 1):
         id_list, sizes, bound = [], [], 0
         for f in feats:
             _, spec, offset = coll.resolve(f)
@@ -118,8 +118,14 @@ def make_sparse_train_step(
             id_list.append(flat)
             sizes.append(flat.shape[0])
             # static per-feature distinct bound: a feature can touch at most
-            # min(its id count, its member vocab) rows
-            bound += min(flat.shape[0], spec.num_embeddings)
+            # min(its id count, its member vocab) rows — or, for fat-line
+            # arrays, that many LINES (+1: a member's row range may straddle
+            # one extra line at each unaligned stack offset)
+            if rows_per_line == 1:
+                bound += min(flat.shape[0], spec.num_embeddings)
+            else:
+                bound += min(flat.shape[0],
+                             -(-spec.num_embeddings // rows_per_line) + 1)
         return jnp.concatenate(id_list), sizes, bound
 
     def step(state: SparseTrainState, batch, rng=None) -> tuple[SparseTrainState, jax.Array]:
@@ -138,8 +144,6 @@ def make_sparse_train_step(
 
         dedup_ctx: dict[str, tuple] = {}
         if dedup_lookup:
-            from tdfo_tpu.ops.pallas_kernels import fat_components
-
             embs = {}
             for tname, feats in by_table_static.items():
                 # column-sharded tables shard the EMBEDDING dim: the compact
@@ -153,20 +157,35 @@ def make_sparse_train_step(
                     continue
                 table = state.tables[tname]
                 d = coll.array_embedding_dim(tname)
-                all_ids, sizes, bound = _concat_ids(feats, ids)
+                fat = table.ndim == 3
+                lay = coll.fat_layout_for(tname) if fat else None
+                r = lay.r if fat else 1
+                all_ids, sizes, bound = _concat_ids(feats, ids, rows_per_line=r)
                 total = all_ids.shape[0]
                 # +1 slack: negative (padding) ids dedupe to ONE sentinel
                 # slot beyond the real-id bound; without it the expand would
                 # clamp the sentinel seg onto a real row's slot
                 cap = (-(-(bound + 1) // 8) * 8) if bound + 1 < total else None
                 uids, seg, valid = dedupe_ids(
-                    all_ids.astype(jnp.int32), capacity=cap, max_distinct=cap
+                    all_ids.astype(jnp.int32), capacity=cap, max_distinct=cap,
+                    rows_per_line=r,
                 )
-                rows = jnp.take(
-                    table, jnp.minimum(uids, table.shape[0] - 1), axis=0
-                )
-                if table.ndim == 3:  # fat rows: slice the table component
-                    rows = fat_components(rows, d)[0]
+                if fat:
+                    # gather whole packed LINES straight off the 3D array
+                    # (the fast TPU gather — reshaping the table to a row
+                    # view materialises a multi-GB copy), then slice the R
+                    # slot rows out of the small gathered block.  ``seg``
+                    # already indexes the C x R line-slot space.  Sentinel
+                    # lines clamp to line 0 slot 0 = row 0, exactly like
+                    # the default lookup's clip of out-of-contract ids.
+                    lines = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
+                    flat = lines.reshape(lines.shape[0], lay.tiles * 128)
+                    rows = jnp.concatenate(
+                        [flat[:, None, s * lay.w: s * lay.w + d]
+                         for s in range(r)], axis=1,
+                    ).reshape(lines.shape[0] * r, d)
+                else:
+                    rows = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
                 off = 0
                 for f, n_f in zip(feats, sizes):
                     e = jnp.take(rows, seg[off:off + n_f], axis=0)
@@ -208,13 +227,33 @@ def make_sparse_train_step(
                 # shared-dedupe fast path: segment-sum by the forward's seg
                 # and feed the optimizer tiers directly (no second sort)
                 uids, seg, valid = dedup_ctx[tname]
+                d_t = coll.array_embedding_dim(tname)
+                if state.tables[tname].ndim == 3:
+                    # line-level operands (seg spans the C x R slot space):
+                    # straight into the in-place DMA kernel, zero scatters
+                    lay = coll.fat_layout_for(tname)
+                    c = uids.shape[0]
+                    g_slots = jax.ops.segment_sum(
+                        all_grads.astype(jnp.float32), seg,
+                        num_segments=c * lay.r,
+                    )
+                    touched = jax.ops.segment_sum(
+                        jnp.ones_like(seg, jnp.float32), seg,
+                        num_segments=c * lay.r,
+                    )
+                    new_tables[tname], new_slots[tname] = (
+                        state.sparse_opt.update_unique_lines(
+                            state.tables[tname], state.slots[tname], uids,
+                            g_slots, touched, embedding_dim=d_t,
+                        ))
+                    continue
                 g_u = jax.ops.segment_sum(
                     all_grads, seg, num_segments=uids.shape[0]
                 )
                 g_u = jnp.where(valid[:, None], g_u, 0.0)
                 new_tables[tname], new_slots[tname] = state.sparse_opt.update_unique(
                     state.tables[tname], state.slots[tname], uids, g_u, valid,
-                    embedding_dim=coll.array_embedding_dim(tname),
+                    embedding_dim=d_t,
                 )
                 continue
             all_ids, _, bound = _concat_ids(feats, ids)

@@ -372,6 +372,7 @@ class Trainer:
             mesh=self.mesh,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
             stack_tables=cfg.stack_tables,
+            fused_kind=cfg.sparse_optimizer,
         )
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)
@@ -445,6 +446,7 @@ class Trainer:
             jax.random.key(cfg.seed), self.model_cfg, self.mesh,
             sharding=sharding, attn=cfg.attn,
             fused_threshold=cfg.effective_fused_threshold,
+            fused_kind=cfg.sparse_optimizer,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
             ring_block_k=cfg.ring_block_k or None,
             tp_heads=cfg.tensor_parallel and cfg.attn in ("ring", "ring_flash"),

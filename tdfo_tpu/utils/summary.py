@@ -42,11 +42,12 @@ def param_summary(
     rows = _rows_from_tree(params)
     if tables is not None:
         for name, arr in sorted(tables.items()):
-            if coll is not None and arr.ndim == 3:  # fat storage
+            if coll is not None and arr.ndim == 3:  # fat-line storage
                 d = coll.array_embedding_dim(name)
-                count = arr.shape[0] * d
-                rows.append((f"tables/{name} (fat {tuple(arr.shape)} incl. moments)",
-                             (arr.shape[0], d), str(arr.dtype), count))
+                r = coll.fat_layout_for(name).r
+                count = arr.shape[0] * r * d
+                rows.append((f"tables/{name} (fat {tuple(arr.shape)} incl. opt state)",
+                             (arr.shape[0] * r, d), str(arr.dtype), count))
             else:
                 rows.append((f"tables/{name}", tuple(arr.shape), str(arr.dtype),
                              int(np.prod(arr.shape) or 1)))

@@ -237,37 +237,40 @@ class TestFatStacking:
         tables = coll.init(jax.random.key(0))
         (stack,) = [n for n in tables if n.startswith("__fatstack_")]
         assert set(tables) == {stack, "c"}
-        assert tables[stack].ndim == 3 and tables[stack].shape[0] == 40
+        lay = coll.fat_layout(8)
+        assert tables[stack].ndim == 3
+        assert tables[stack].shape[0] == lay.n_lines(40)  # 40 packed rows
         aname, spec_a, off_a = coll.resolve("fa")
         bname, spec_b, off_b = coll.resolve("fb")
         assert aname == bname == stack and off_a == 0 and off_b == 24
-        from tdfo_tpu.ops.pallas_kernels import fat_components
+        from tdfo_tpu.ops.pallas_kernels import fat_unpack
 
         ids = jnp.array([0, 3, 15], jnp.int32)
         out = coll.lookup(tables, {"fb": ids})["fb"]
-        want = fat_components(tables[stack], 8)[0][24 + np.asarray(ids)]
+        table_vals = fat_unpack(tables[stack], lay, rows=40)[0]
+        want = table_vals[24 + np.asarray(ids)]
         np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
         # member init scales are respected (b's rows are much smaller)
-        table_vals = fat_components(tables[stack], 8)[0]
         assert float(jnp.abs(table_vals[:24]).max()) > 0.25
         assert float(jnp.abs(table_vals[24:40]).max()) <= 0.1 + 1e-6
 
     def test_sparse_update_isolates_members(self):
-        from tdfo_tpu.ops.pallas_kernels import fat_components
+        from tdfo_tpu.ops.pallas_kernels import fat_unpack
         from tdfo_tpu.ops.sparse import sparse_optimizer
 
         coll = self._coll()
         tables = coll.init(jax.random.key(1))
         (stack,) = [n for n in tables if n.startswith("__fatstack_")]
+        lay = coll.fat_layout(8)
         opt = sparse_optimizer("adam", lr=0.1)
         slots = opt.init(tables[stack])
-        before = fat_components(tables[stack], 8)[0]
+        before = fat_unpack(tables[stack], lay, rows=40)[0]
         # the train step offsets feature ids into stack space (resolve());
         # update feature b's row 2 -> stack row 26 only
         ids = jnp.array([26], jnp.int32)
         g = jnp.ones((1, 8), jnp.float32)
         new, _ = coll.sparse_update(opt, stack, tables[stack], slots, ids, g)
-        after = fat_components(new, 8)[0]
+        after = fat_unpack(new, lay, rows=40)[0]
         changed = np.flatnonzero(
             np.any(np.asarray(before != after), axis=1))
         np.testing.assert_array_equal(changed, [26])

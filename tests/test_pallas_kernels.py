@@ -10,13 +10,20 @@ import numpy as np
 import pytest
 
 from tdfo_tpu.ops.pallas_kernels import (
-    fat_adam_rows,
-    fat_components,
-    fat_layout,
     fat_pack,
+    fat_unpack,
+    fat_view,
     flash_attention,
+    line_layout,
 )
-from tdfo_tpu.ops.sparse import dedupe_grads, sparse_adam
+from tdfo_tpu.ops.sparse import (
+    dedupe_grads,
+    fat_apply_unique,
+    sparse_adagrad,
+    sparse_adam,
+    sparse_rowwise_adagrad,
+    sparse_sgd,
+)
 
 
 def _qkv(key, b=2, h=2, t=128, dh=32):
@@ -82,81 +89,182 @@ class TestFlashAttention:
 
 
 class TestFatLayout:
+    @pytest.mark.parametrize("d,kind,w,r,tiles", [
+        (16, "rowwise_adagrad", 32, 4, 1),
+        (16, "sgd", 16, 8, 1),
+        (16, "adagrad", 32, 4, 1),
+        (16, "adam", 64, 2, 1),
+        (64, "rowwise_adagrad", 128, 1, 1),
+        (64, "adam", 256, 1, 2),
+        (8, "sgd", 8, 16, 1),
+        (128, "adam", 384, 1, 3),
+    ])
+    def test_geometry(self, d, kind, w, r, tiles):
+        lay = line_layout(d, kind)
+        assert (lay.w, lay.r, lay.tiles) == (w, r, tiles)
+        assert lay.r * lay.w == lay.tiles * 128  # contiguous-view invariant
+
     @pytest.mark.parametrize("d", [16, 42, 64, 96, 128, 200])
-    def test_pack_components_roundtrip(self, d):
+    def test_pack_unpack_roundtrip_adam(self, d):
         rng = np.random.default_rng(d)
         v = 24
         t, mu, nu = (jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
                      for _ in range(3))
         fat = fat_pack(t, mu, nu)
-        stride, tiles = fat_layout(d)
-        assert fat.shape == (v, tiles, 128)
-        assert stride >= d and stride % 64 == 0
-        got = fat_components(fat, d)
+        lay = line_layout(d, "adam")
+        assert fat.shape == (lay.n_lines(v), lay.tiles, 128)
+        got = fat_unpack(fat, lay, rows=v)
         for a, b in zip(got, (t, mu, nu)):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    @pytest.mark.parametrize("kind", ["sgd", "rowwise_adagrad", "adagrad"])
+    def test_pack_unpack_roundtrip_other_kinds(self, kind):
+        rng = np.random.default_rng(11)
+        v, d = 37, 16
+        t = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+        state = ()
+        if kind == "rowwise_adagrad":
+            state = (jnp.asarray(rng.random(v).astype(np.float32)),)
+        elif kind == "adagrad":
+            state = (jnp.asarray(rng.random((v, d)).astype(np.float32)),)
+        fat = fat_pack(t, *state, kind=kind)
+        got = fat_unpack(fat, line_layout(d, kind), rows=v)
+        np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(t))
+        for a, b in zip(got[1:], state):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
-class TestFatAdamRows:
+    def test_view_gather_matches_table(self):
+        rng = np.random.default_rng(5)
+        v, d = 100, 16
+        lay = line_layout(d, "rowwise_adagrad")
+        t = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+        view = fat_view(fat_pack(t, kind="rowwise_adagrad"), lay)
+        ids = jnp.asarray(rng.integers(0, v, 33).astype(np.int32))
+        np.testing.assert_array_equal(
+            np.asarray(jnp.take(view, ids, axis=0)[:, :d]), np.asarray(t[ids])
+        )
+
+
+def _ref_update(kind, table, state, uids, g, valid, lr, wd):
+    if kind == "sgd":
+        return sparse_sgd(table, uids, g, valid, lr=lr, weight_decay=wd), ()
+    if kind == "rowwise_adagrad":
+        t, acc = sparse_rowwise_adagrad(table, state[0], uids, g, valid,
+                                        lr=lr, eps=1e-8, weight_decay=wd)
+        return t, (acc,)
+    if kind == "adagrad":
+        t, acc = sparse_adagrad(table, state[0], uids, g, valid, lr=lr,
+                                eps=1e-8, weight_decay=wd)
+        return t, (acc,)
+    t, mu, nu, _ = sparse_adam(table, state[0], state[1],
+                               jnp.asarray(0, jnp.int32), uids, g, valid,
+                               lr=lr, weight_decay=wd)
+    return t, (mu, nu)
+
+
+def _zero_state(kind, v, d):
+    if kind == "sgd":
+        return ()
+    if kind == "rowwise_adagrad":
+        return (jnp.zeros((v,), jnp.float32),)
+    if kind == "adagrad":
+        return (jnp.zeros((v, d), jnp.float32),)
+    return (jnp.zeros((v, d), jnp.float32), jnp.zeros((v, d), jnp.float32))
+
+
+class TestFatLineUpdate:
+    """The in-place DMA kernel (interpret mode) must reproduce the plain
+    per-row XLA formulations for EVERY fused optimizer kind — fbgemm fused
+    EmbOptimType parity (torchrec/train.py:187-195)."""
+
     def _setup(self, v=64, d=64, b=32, seed=0):
         rng = np.random.default_rng(seed)
         table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
-        mu = jnp.zeros((v, d), jnp.float32)
-        nu = jnp.zeros((v, d), jnp.float32)
         ids = jnp.asarray(rng.integers(0, v, b).astype(np.int32))
         grads = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
-        return table, mu, nu, ids, grads
+        return table, ids, grads
 
-    @pytest.mark.parametrize("d", [16, 64, 128])
-    def test_matches_xla_sparse_adam(self, d):
-        """The in-place DMA kernel (interpret mode) must reproduce the plain
-        three-buffer XLA lazy Adam exactly."""
-        table, mu, nu, ids, grads = self._setup(d=d)
+    @pytest.mark.parametrize("kind,d", [
+        ("adam", 16), ("adam", 64),
+        ("rowwise_adagrad", 16), ("rowwise_adagrad", 64),
+        ("adagrad", 16), ("sgd", 16),
+    ])
+    def test_matches_xla_row_formulation(self, kind, d):
+        table, ids, grads = self._setup(d=d)
+        v = table.shape[0]
         uids, g, valid = dedupe_grads(ids, grads)
-        count = jnp.asarray(0, jnp.int32)
-        t_ref, mu_ref, nu_ref, _ = sparse_adam(
-            table, mu, nu, count, uids, g, valid, lr=1e-2, weight_decay=0.01
+        state = _zero_state(kind, v, d)
+        t_ref, s_ref = _ref_update(kind, table, state, uids, g, valid,
+                                   lr=1e-2, wd=0.01)
+        fat = fat_pack(table, kind=kind)
+        slots = (jnp.zeros((), jnp.int32),) if kind == "adam" else ()
+        fat_new, _ = fat_apply_unique(
+            fat, slots, uids, g, valid, embedding_dim=d, kind=kind, lr=1e-2,
+            weight_decay=0.01, interpret=True,
         )
-        fat = fat_pack(table, mu, nu)
-        fat_new = fat_adam_rows(
-            fat, uids, g, count + 1, d=d, lr=1e-2, weight_decay=0.01,
-            interpret=True,
-        )
-        t_pl, mu_pl, nu_pl = fat_components(fat_new, d)
-        np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
-        np.testing.assert_allclose(np.asarray(mu_pl), np.asarray(mu_ref), rtol=1e-5, atol=1e-6)
-        np.testing.assert_allclose(np.asarray(nu_pl), np.asarray(nu_ref), rtol=1e-5, atol=1e-6)
+        got = fat_unpack(fat_new, line_layout(d, kind), rows=v)
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(t_ref),
+                                   rtol=1e-5, atol=1e-6)
+        for a, b in zip(got[1:], s_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
 
     def test_untouched_rows_unchanged(self):
-        table, mu, nu, ids, grads = self._setup()
+        table, ids, grads = self._setup()
         uids, g, valid = dedupe_grads(ids, grads)
-        fat = fat_pack(table, mu, nu)
-        fat_new = fat_adam_rows(
-            fat, uids, g, jnp.asarray(1, jnp.int32), d=table.shape[1], lr=1e-2,
-            interpret=True,
+        fat = fat_pack(table, kind="adam")
+        fat_new, _ = fat_apply_unique(
+            fat, (jnp.zeros((), jnp.int32),), uids, g, valid,
+            embedding_dim=table.shape[1], kind="adam", lr=1e-2, interpret=True,
         )
         touched = set(np.asarray(uids[np.asarray(valid)]).tolist())
+        view, view_new = (np.asarray(fat_view(f, line_layout(64, "adam")))
+                          for f in (fat, fat_new))
         for r in range(table.shape[0]):
             if r not in touched:
-                np.testing.assert_array_equal(
-                    np.asarray(fat_new[r]), np.asarray(fat[r])
-                )
+                np.testing.assert_array_equal(view_new[r], view[r])
 
     def test_padding_slots_are_noops(self):
-        table, mu, nu, _, _ = self._setup(b=8)
+        table, _, _ = self._setup(b=8)
         d = table.shape[1]
         sent = jnp.iinfo(jnp.int32).max
         uids = jnp.array([3, 7] + [sent] * 6, jnp.int32)
         g = jnp.ones((8, d), jnp.float32)
         g = g.at[2:].set(999.0)  # garbage grads on padding slots must not land
-        fat = fat_pack(table, mu, nu)
-        fat_new = fat_adam_rows(
-            fat, uids, g, jnp.asarray(1, jnp.int32), d=d, lr=1e-2, interpret=True
+        fat = fat_pack(table, kind="adam")
+        fat_new, _ = fat_apply_unique(
+            fat, (jnp.zeros((), jnp.int32),), uids, g, None, embedding_dim=d,
+            kind="adam", lr=1e-2, interpret=True,
         )
-        t_pl = fat_components(fat_new, d)[0]
+        t_pl = fat_unpack(fat_new, line_layout(d, "adam"))[0]
         assert not np.array_equal(np.asarray(t_pl[3]), np.asarray(table[3]))
         assert not np.array_equal(np.asarray(t_pl[7]), np.asarray(table[7]))
         np.testing.assert_array_equal(np.asarray(t_pl[0]), np.asarray(table[0]))
+
+    def test_shared_line_slots_update_independently(self):
+        """Two touched rows in the SAME packed line (R > 1) plus untouched
+        neighbours: per-slot gating must keep neighbours bit-identical."""
+        rng = np.random.default_rng(9)
+        v, d, kind = 16, 16, "rowwise_adagrad"  # R = 4: rows 0-3 share line 0
+        table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+        ids = jnp.asarray([0, 2, 0, 9], jnp.int32)
+        grads = jnp.asarray(rng.normal(size=(4, d)).astype(np.float32))
+        uids, g, valid = dedupe_grads(ids, grads)
+        acc = jnp.zeros((v,), jnp.float32)
+        t_ref, s_ref = _ref_update(kind, table, (acc,), uids, g, valid,
+                                   lr=1e-2, wd=0.01)
+        fat_new, _ = fat_apply_unique(
+            fat_pack(table, kind=kind), (), uids, g, valid, embedding_dim=d,
+            kind=kind, lr=1e-2, weight_decay=0.01, interpret=True,
+        )
+        got_t, got_acc = fat_unpack(fat_new, line_layout(d, kind), rows=v)
+        np.testing.assert_allclose(np.asarray(got_t), np.asarray(t_ref),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_acc), np.asarray(s_ref[0]),
+                                   rtol=1e-5, atol=1e-6)
+        # rows 1 and 3 share line 0 with touched rows 0/2 but must be intact
+        np.testing.assert_array_equal(np.asarray(got_t[1]), np.asarray(table[1]))
+        np.testing.assert_array_equal(np.asarray(got_t[3]), np.asarray(table[3]))
 
 
 class TestSparseOptimizerTiers:
@@ -183,20 +291,23 @@ class TestSparseOptimizerTiers:
         np.testing.assert_allclose(np.asarray(s_a[0]), np.asarray(s_b[0]), rtol=1e-5, atol=1e-6)
         assert int(s_a[2]) == int(s_b[2]) == 1
 
-    @pytest.mark.parametrize("d", [64, 200])
-    def test_fat_tier_matches_plain(self, d):
+    @pytest.mark.parametrize("kind,d", [
+        ("adam", 64), ("adam", 200), ("rowwise_adagrad", 16), ("sgd", 16),
+    ])
+    def test_fat_tier_matches_plain(self, kind, d):
         from tdfo_tpu.ops.sparse import sparse_optimizer
 
         table, ids, grads = self._data(v=64, d=d)
-        opt = sparse_optimizer("adam", lr=1e-2, weight_decay=0.01,
+        opt = sparse_optimizer(kind, lr=1e-2, weight_decay=0.01,
                                small_vocab_threshold=0)
         t_ref, _ = opt.update(table, opt.init(table), ids, grads)
-        fat = fat_pack(table, jnp.zeros_like(table), jnp.zeros_like(table))
+        fat = fat_pack(table, kind=kind)
         fat_new, slots = opt.update(fat, opt.init(fat), ids, grads,
                                     embedding_dim=d)
-        t_fat = fat_components(fat_new, d)[0]
+        t_fat = fat_unpack(fat_new, line_layout(d, kind), rows=64)[0]
         np.testing.assert_allclose(np.asarray(t_fat), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
-        assert int(slots[0]) == 1
+        if kind == "adam":
+            assert int(slots[0]) == 1
 
 
 def test_bert4rec_flash_attn_matches_full(mesh8):
@@ -266,9 +377,9 @@ def test_flash_backward_padded_seq_len():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
 
 
-@pytest.mark.parametrize("u", [129, 257, 400])
-def test_fat_adam_multi_block_pipeline(u):
-    """>128 touched rows forces multiple grid steps, exercising the
+@pytest.mark.parametrize("u", [129, 400])
+def test_fat_multi_block_pipeline(u):
+    """>128 touched lines forces multiple grid steps, exercising the
     double-buffered steady state (block i-1 write drain, block i+1 read
     prefetch, final-block drain) — not just the i==0 branch."""
     rng = np.random.default_rng(u)
@@ -283,11 +394,12 @@ def test_fat_adam_multi_block_pipeline(u):
     t_ref, mu_ref, nu_ref, _ = sparse_adam(
         table, mu, nu, count, uids, g, valid, lr=1e-2, weight_decay=0.01
     )
-    fat_new = fat_adam_rows(
-        fat_pack(table, mu, nu), uids, g, count + 1, d=d, lr=1e-2,
-        weight_decay=0.01, interpret=True,
+    fat_new, slots = fat_apply_unique(
+        fat_pack(table, mu, nu), (count,), uids, g, valid, embedding_dim=d,
+        kind="adam", lr=1e-2, weight_decay=0.01, interpret=True,
     )
-    t_pl, mu_pl, nu_pl = fat_components(fat_new, d)
+    assert int(slots[0]) == 5
+    t_pl, mu_pl, nu_pl = fat_unpack(fat_new, line_layout(d, "adam"), rows=v)
     np.testing.assert_allclose(np.asarray(t_pl), np.asarray(t_ref), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(mu_pl), np.asarray(mu_ref), rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(np.asarray(nu_pl), np.asarray(nu_ref), rtol=1e-5, atol=1e-6)
