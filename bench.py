@@ -265,12 +265,17 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
     cats = tuple(f"cat_{i}" for i in range(26))
     conts = tuple(f"cont_{i}" for i in range(13))
     size_map = {c: v for c, v in zip(cats, CRITEO_KAGGLE_VOCABS)}
-    # fused_threshold=0: EVERY table rides the fat-line stack, so the whole
-    # step contains no XLA scatter at all (one dedupe sort + one segment-sum
-    # + one in-place DMA kernel)
+    # Plain stacked tables measured FASTER than fused fat-line storage for
+    # this profile (22.5 vs ~29 ms/step): at ~100k scattered row-touches the
+    # XLA row scatter (~10 ms at the deduped 101k-slot bound) beats the
+    # per-line DMA kernel + its operand routing, while the fat layout's
+    # 512B line granularity also taxes the forward gather.  The fused path
+    # remains the right choice for memory-bound tables (optimizer state
+    # packed in-line) and for small touch counts (twotower d=64 adam);
+    # docs/BUDGET.md carries the full measured decomposition.
     coll = ShardedEmbeddingCollection(
         generic_embedding_specs(size_map, cats, embed_dim, "row",
-                                fused_threshold=0),
+                                fused_threshold=None),
         mesh=mesh, stack_tables=True, fused_kind="rowwise_adagrad",
     )
     # shapes only — the real tables are built INSIDE the jitted chain (a
@@ -389,6 +394,9 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int,
         sparse_opt=sparse_optimizer("adam", lr=3e-4, weight_decay=1e-4),
     )
     b = batch_size * mesh.shape["data"]
+    # no dedup_lookup here: at ~8k touched rows/step the shared-sort
+    # machinery costs more than it saves (measured 2.08 vs 1.3 ms/step);
+    # dedup pays off at the Criteo profile's ~100k touches
     inner = make_sparse_train_step(
         coll, ctr_sparse_forward(backbone), jit=False, donate=False
     )

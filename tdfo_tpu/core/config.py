@@ -117,7 +117,10 @@ class Config:
     # alltoall send-bucket capacity as a multiple of the balanced share
     # (local_batch / n_shards); 0 = exact worst case (capacity = local
     # batch).  Finite factors shrink the a2a payload ~n_shards/factor but
-    # drop ids past a bucket's capacity (zero vectors) under extreme skew.
+    # DROP ids past a bucket's capacity under extreme skew — they resolve
+    # to zero vectors, a silent quality hazard.  The Trainer therefore logs
+    # `a2a_overflow_ids` (dropped ids in the logged batch) at every log
+    # boundary in this regime; watch it when tuning the factor.
     a2a_capacity_factor: float = 0.0
     # attention core for sequence models: "full" (T x T), "ring"
     # (sequence-parallel over the seq mesh axis; XLA blockwise innards —

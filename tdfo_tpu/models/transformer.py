@@ -62,9 +62,11 @@ class MultiHeadAttention(nn.Module):
         # chunks of the fused output features are then whole heads, so a
         # Megatron column split of the qkv kernel (megatron_tp_rule) shards
         # cleanly onto the head axis under GSPMD with no resharding.
-        # COMPAT: this reinterprets the fused kernel's columns — checkpoints
-        # saved under the pre-round-4 (qkv, head, dh) layout load without
-        # error but scramble q/k/v; retrain or permute the kernel on load.
+        # COMPAT: this reinterprets the fused kernel's columns vs the old
+        # (qkv, head, dh) layout — same shapes, scrambled values.  Guarded
+        # by the checkpoint layout stamp: CheckpointManager refuses to
+        # restore checkpoints from a different LAYOUT_VERSION
+        # (train/checkpoint.py) instead of resuming silently corrupted.
         qkv = qkv.reshape(b, t, self.n_heads, 3, dh)
         q, k, v = (jnp.swapaxes(qkv[:, :, :, i, :], 1, 2) for i in range(3))  # [B,H,T,Dh]
         out = self.attn_fn(q, k, v, mask)  # [B, H, T, Dh]

@@ -375,6 +375,124 @@ def _fat_apply_lines_xla(fat, ulines, g_slots, touched, *, layout, lr, b1,
     return view.at[idx].set(new_rows, mode="drop").reshape(fat.shape)
 
 
+def dedupe_rows_and_lines(ids, *, capacity_rows: int, capacity_lines: int,
+                          rows_per_line: int):
+    """Row- AND line-level dedupe from ONE sort pass (the fat-line routed
+    path): ``ids[B] -> (seg_row[B], ulines[CL], row_lidx[CR], row_slot[CR])``.
+
+    ``seg_row`` maps each batch position to its distinct-row slot (the
+    forward expand / backward row segment-sum key — the CHEAP segment
+    space); ``ulines`` are the distinct line ids (sorted, int32-max
+    sentinels at the top); ``row_lidx``/``row_slot`` give each distinct
+    row's line slot and within-line slot (``capacity_lines`` fills unused
+    row slots so they route past every real line).  Negative ids group
+    under the sentinel line with slot 0, so they gather row 0 (default-path
+    clip parity) and their update drops with the sentinel line.
+    """
+    b = ids.shape[0]
+    r = rows_per_line
+    oob = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+    ids = ids.astype(jnp.int32)
+    clean = jnp.where(ids >= 0, ids, oob)
+    iota = jnp.arange(b, dtype=jnp.int32)
+    sorted_ids, order = jax.lax.sort((clean, iota), num_keys=1, is_stable=False)
+    ok = sorted_ids < oob
+    first_r = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_ids[1:] != sorted_ids[:-1]])
+    uidx = (jnp.cumsum(first_r) - 1).astype(jnp.int32)
+    line = jnp.where(ok, sorted_ids // r, oob)
+    slot = jnp.where(ok, sorted_ids % r, 0)
+    first_l = jnp.concatenate([jnp.ones((1,), bool), line[1:] != line[:-1]])
+    lidx = (jnp.cumsum(first_l) - 1).astype(jnp.int32)
+    _, seg_row = jax.lax.sort((order, uidx), num_keys=1, is_stable=False)
+    ulines = jnp.full((capacity_lines,), oob, jnp.int32).at[lidx].set(
+        line, mode="drop")
+    row_lidx = jnp.full((capacity_rows,), capacity_lines, jnp.int32).at[
+        uidx].set(lidx, mode="drop")
+    row_slot = jnp.zeros((capacity_rows,), jnp.int32).at[uidx].set(
+        slot, mode="drop")
+    return seg_row, ulines, row_lidx, row_slot
+
+
+def fat_apply_routed(fat, slots, ulines, g_u, row_lidx, row_slot, lines, *,
+                     embedding_dim, kind, lr, b1=0.9, b2=0.999, eps=1e-8,
+                     weight_decay=0.0, interpret: bool = False):
+    """Fused fat-line step on ROW-level summed grads + routing info from
+    :func:`dedupe_rows_and_lines` — the fastest update path: the expensive
+    C x R slot-space segment-sum never exists; the kernel routes window
+    rows into packed lanes itself, and ``lines`` (the forward's gather of
+    the touched lines, [C, T, 128] in ulines order) spares it every read
+    DMA.  Returns ``(fat, slots)``."""
+    from tdfo_tpu.ops.pallas_kernels import (
+        fat_line_update_routed,
+        line_layout,
+    )
+
+    layout = line_layout(embedding_dim, kind)
+    r = layout.r
+    cl = ulines.shape[0]
+    cr = g_u.shape[0]
+    if kind == "adam":
+        (count,) = slots
+        new_count = count + 1
+        t = new_count.astype(jnp.float32)
+        corr = jnp.stack([1.0 - b1**t, 1.0 - b2**t])
+        new_slots = (new_count,)
+    else:
+        new_count = None
+        corr = jnp.zeros((2,), jnp.float32)
+        new_slots = slots
+    if layout.d <= 128 and (jax.default_backend() == "tpu" or interpret):
+        from tdfo_tpu.ops.pallas_kernels import routed_lines_per_step
+
+        oob = jnp.iinfo(jnp.int32).max
+        lines_per_step = routed_lines_per_step(layout)
+        cl_pad = -(-cl // lines_per_step) * lines_per_step
+        nblocks = cl_pad // lines_per_step
+        rpb = lines_per_step * r
+        ulines_p = jnp.pad(ulines, (0, cl_pad - cl), constant_values=oob)
+        lines_p = jnp.pad(lines.astype(jnp.float32),
+                          ((0, cl_pad - cl), (0, 0), (0, 0)))
+        # row ranges per block: row_lidx is non-decreasing (sorted uniques)
+        block_start = jnp.searchsorted(
+            row_lidx, jnp.arange(nblocks, dtype=jnp.int32) * lines_per_step,
+            method="sort",
+        ).astype(jnp.int32)
+        sdiv = block_start // rpb
+        rows_pad = (cr // rpb + 2) * rpb
+        # lane-pad to 128: the kernel's window DMA source is (1,128)-tiled
+        g_pad = jnp.pad(g_u.astype(jnp.float32),
+                        ((0, rows_pad - cr), (0, 128 - g_u.shape[1])))
+        slotidx = jnp.pad(
+            jnp.minimum(row_lidx, cl) * r + row_slot,
+            (0, rows_pad - cr), constant_values=jnp.int32(cl) * r,
+        )
+        gk = sdiv[:, None] * rpb + jnp.arange(2 * rpb, dtype=jnp.int32)[None, :]
+        tsi = (jnp.take(slotidx, jnp.minimum(gk, rows_pad - 1), axis=0)
+               - (jnp.arange(nblocks, dtype=jnp.int32) * rpb)[:, None])
+        # 8-sublane broadcast: a (1, 2RPB) block is not Mosaic-tileable
+        tsi = jnp.broadcast_to(tsi[:, None, :], (nblocks, 8, 2 * rpb))
+        fat = fat_line_update_routed(
+            fat, lines_p, ulines_p, sdiv, tsi, g_pad, corr, layout=layout,
+            lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
+            interpret=interpret,
+        )
+        return fat, new_slots
+    # XLA fallback: construct the line-slot operands by (cheap on CPU)
+    # scatter, then share the verified line-level formulation
+    slotidx = jnp.minimum(row_lidx, cl).astype(jnp.int32) * r + row_slot
+    slotidx = jnp.where(row_lidx < cl, slotidx, cl * r)  # padding -> dropped
+    g_slots = jnp.zeros((cl * r, g_u.shape[1]), jnp.float32).at[slotidx].set(
+        g_u.astype(jnp.float32), mode="drop")
+    touched = jnp.zeros((cl * r,), jnp.float32).at[slotidx].set(
+        1.0, mode="drop")
+    fat = _fat_apply_lines_xla(
+        fat, ulines, g_slots, touched, layout=layout, lr=lr, b1=b1, b2=b2,
+        eps=eps, weight_decay=weight_decay, new_count=new_count,
+    )
+    return fat, new_slots
+
+
 def _fat_apply_lines(fat, slots, ulines, g_slots, touched, *, layout, lr,
                      b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
                      interpret: bool = False):
@@ -397,15 +515,31 @@ def _fat_apply_lines(fat, slots, ulines, g_slots, touched, *, layout, lr,
         new_slots = slots
     c = ulines.shape[0]
     g_slots = g_slots.reshape(c, layout.r, -1)
-    touched_f = (touched.reshape(c, layout.r) > 0).astype(jnp.float32)
+    if touched is None:
+        # R == 1 licence: one row per line, so every valid line is touched
+        # (kernel write-skip / fallback line-drop subsume the mask)
+        assert layout.r == 1, "touched=None requires rows_per_line == 1"
+        touched_f = (ulines < fat.shape[0]).astype(jnp.float32)[:, None]
+    else:
+        touched_f = (touched.reshape(c, layout.r) > 0).astype(jnp.float32)
     # d > 128 lines span 4+ tiles — rare configs with no on-chip coverage;
     # keep them on the proven XLA formulation (the pre-existing guard)
     if layout.d <= 128 and (jax.default_backend() == "tpu" or interpret):
-        gp, tl = _pack_lanes(g_slots.astype(jnp.float32), touched_f, layout)
-        fat = fat_line_update(
-            fat, ulines, gp, tl, corr, layout=layout, lr=lr, b1=b1, b2=b2,
-            eps=eps, weight_decay=weight_decay, interpret=interpret,
-        )
+        if layout.r == 1:
+            # row-form operands: stream d lanes per line, no touched mask
+            fat = fat_line_update(
+                fat, ulines, g_slots.reshape(c, -1).astype(jnp.float32),
+                None, corr, layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
+                weight_decay=weight_decay, interpret=interpret,
+            )
+        else:
+            gp, tl = _pack_lanes(g_slots.astype(jnp.float32), touched_f,
+                                 layout)
+            fat = fat_line_update(
+                fat, ulines, gp, tl, corr, layout=layout, lr=lr, b1=b1,
+                b2=b2, eps=eps, weight_decay=weight_decay,
+                interpret=interpret,
+            )
     else:
         fat = _fat_apply_lines_xla(
             fat, ulines, g_slots.reshape(c * layout.r, -1), touched_f,
@@ -423,8 +557,8 @@ def fat_apply_unique(fat, slots, uids, g, valid=None, *, embedding_dim, kind,
     sentinels at the top (the :func:`dedupe_grads` layout) — the line
     grouping then needs no extra sort.  Returns ``(fat, slots)``.
 
-    Prefer the line-level path (``dedupe_ids(rows_per_line=R)`` +
-    ``SparseOptimizer.update_unique_lines``) in hot steps: it skips the
+    Prefer the routed path (``dedupe_rows_and_lines`` +
+    ``SparseOptimizer.update_routed``) in hot steps: it skips the
     row->line scatters entirely.
     """
     from tdfo_tpu.ops.pallas_kernels import line_layout
@@ -467,7 +601,7 @@ def fat_update(fat, slots, ids, grads, *, embedding_dim, kind, lr, b1=0.9,
     g_slots = jax.ops.segment_sum(
         grads.astype(jnp.float32), seg, num_segments=c * r
     )
-    touched = jax.ops.segment_sum(
+    touched = None if r == 1 else jax.ops.segment_sum(
         (ids >= 0).astype(jnp.float32), seg, num_segments=c * r
     )
     return _fat_apply_lines(
@@ -523,18 +657,18 @@ class SparseOptimizer:
             )
         raise ValueError(f"unknown sparse optimizer kind: {self.kind!r}")
 
-    def update_unique_lines(self, table, slots, ulines, g_slots, touched, *,
-                            embedding_dim: int):
-        """Fat-line fast path on line-level operands from
-        ``dedupe_ids(rows_per_line=R)`` — the dedup-lookup step shares ONE
-        sort between the forward's line gather and this update."""
-        from tdfo_tpu.ops.pallas_kernels import line_layout
-
+    def update_routed(self, table, slots, ulines, g_u, row_lidx, row_slot,
+                      lines, *, embedding_dim: int):
+        """Fat-line fastest path: row-level summed grads + routing arrays
+        from :func:`dedupe_rows_and_lines` (the dedup-lookup step shares
+        ONE sort between the forward's line gather — whose result ``lines``
+        the kernel reuses instead of re-reading — the row expand, and this
+        update; the slot-space segment-sum never exists)."""
         if table.ndim != 3:
-            raise ValueError("update_unique_lines is the fat-line path")
-        return _fat_apply_lines(
-            table, slots, ulines, g_slots, touched,
-            layout=line_layout(embedding_dim, self.kind), lr=self.lr,
+            raise ValueError("update_routed is the fat-line path")
+        return fat_apply_routed(
+            table, slots, ulines, g_u, row_lidx, row_slot, lines,
+            embedding_dim=embedding_dim, kind=self.kind, lr=self.lr,
             b1=self.b1, b2=self.b2, eps=self.eps,
             weight_decay=self.weight_decay,
         )

@@ -151,7 +151,13 @@ class ShardedEmbeddingCollection:
         it determines the line geometry, so it must match the
         ``SparseOptimizer`` used by the train step (fbgemm's TBE likewise
         bakes the optimizer into the table storage,
-        ``torchrec/train.py:241-247``)."""
+        ``torchrec/train.py:241-247``).
+
+        Fat-table STACKING (unlike ``stack_tables``) is not a knob: fused
+        storage is itself the opt-in (``fused_table_threshold``), and the
+        checkpoint layout stamp (``train/checkpoint.py LAYOUT_VERSION``)
+        refuses cross-layout resumes, so the stacking's state-key change
+        cannot corrupt an old run silently."""
         from tdfo_tpu.ops.pallas_kernels import line_layout
 
         self.fused_kind = fused_kind
@@ -477,6 +483,52 @@ class ShardedEmbeddingCollection:
             check_vma=False,
         )(table, slots, ids_flat, grads_flat)
         return new_table, new_slots
+
+    def a2a_overflow(self, tables: Mapping[str, jax.Array],
+                     features: Mapping[str, jax.Array]) -> jax.Array:
+        """TOTAL ids this batch that the ``alltoall`` lookup program drops
+        under a finite ``a2a_capacity_factor`` (they resolve to ZERO
+        vectors — the knob's failure mode, torchrec-planner capacity
+        semantics).  A silent quality degradation unless watched: the
+        Trainer folds this counter into its JSONL log at every log
+        boundary in the alltoall regime.  Cheap diagnostic — owner
+        bucketing arithmetic only, no table reads and no collectives
+        beyond one psum; returns a global (replicated) int32 scalar.
+        """
+        if (self.a2a_capacity_factor is None or self.mesh is None
+                or self.n_shards <= 1):
+            return jnp.zeros((), jnp.int32)
+        m = self.n_shards
+        axis = self.axis
+        cf = self.a2a_capacity_factor
+        total = jnp.zeros((), jnp.int32)
+        for feat, ids in features.items():
+            tname, spec, offset = self.resolve(feat)
+            if spec.sharding not in ("row", "table"):
+                continue
+            rows_per_shard = self._rows_per_shard(tables[tname], spec)
+
+            def local(ids_local, rows_per_shard=rows_per_shard, offset=offset):
+                flat = ids_local.reshape(-1) + offset
+                n = flat.shape[0]
+                # mirror _lookup_alltoall's capacity arithmetic exactly
+                cap = min(n, max(1, int(cf * n / m)))
+                if cap < n:
+                    cap = min(n, -(-cap // 8) * 8)
+                owner = jnp.clip(flat // rows_per_shard, 0, m - 1)
+                counts = jnp.sum(
+                    (owner[None, :] == jnp.arange(m)[:, None]), axis=1
+                )
+                dropped = jnp.sum(jnp.maximum(counts - cap, 0))
+                return jax.lax.psum(dropped.astype(jnp.int32), axis)
+
+            cnt = jax.shard_map(
+                local, mesh=self.mesh,
+                in_specs=P(axis, *([None] * (ids.ndim - 1))), out_specs=P(),
+                check_vma=False,
+            )(ids)
+            total = total + cnt
+        return total
 
     def lookup(
         self,

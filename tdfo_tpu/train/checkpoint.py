@@ -23,7 +23,20 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
-__all__ = ["CheckpointManager"]
+__all__ = ["CheckpointManager", "LAYOUT_VERSION"]
+
+# Storage-layout schema version, stamped into every checkpoint and verified
+# on restore.  Bump whenever a parameter's in-memory LAYOUT changes in a way
+# that restores without shape errors but scrambles values:
+#   1: original layouts
+#   2: fused-QKV feature order changed (qkv, head, dh) -> (head, qkv, dh)
+#      (same shapes — silent q/k/v scramble on resume)
+#   3: fat-line embedding storage (line_layout packing; adam d<64 moved from
+#      stride-64 to d-contiguous component offsets, non-adam kinds gained
+#      in-line state)
+# A version mismatch (or a pre-stamping checkpoint) REFUSES to restore with
+# a clear error instead of silently corrupting the resumed run.
+LAYOUT_VERSION = 3
 
 
 class CheckpointManager:
@@ -47,7 +60,11 @@ class CheckpointManager:
         )
 
     def save(self, step_id: int, state: Any, *, force: bool = False) -> None:
-        self._mgr.save(step_id, args=ocp.args.StandardSave(state), force=force)
+        payload = {
+            "layout_version": np.asarray(LAYOUT_VERSION, np.int32),
+            "state": state,
+        }
+        self._mgr.save(step_id, args=ocp.args.StandardSave(payload), force=force)
         self._mgr.wait_until_finished()
 
     def latest_step(self) -> int | None:
@@ -55,15 +72,50 @@ class CheckpointManager:
 
     def restore(self, state_like: Any, step_id: int | None = None):
         """Restore into the structure/shardings of ``state_like``.  Returns
-        ``(step_id, state)`` or ``None`` when no checkpoint exists."""
+        ``(step_id, state)`` or ``None`` when no checkpoint exists.  Refuses
+        checkpoints whose storage-layout version differs from
+        :data:`LAYOUT_VERSION` (same shapes, different value layout — a
+        silent-corruption hazard, e.g. the round-4 fused-QKV reorder or the
+        round-5 fat-line packing)."""
         step_id = self._mgr.latest_step() if step_id is None else step_id
         if step_id is None:
             return None
-        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like)
+        # probe the SAVED tree's metadata for the stamp before restoring:
+        # a missing stamp is the legacy (pre-versioning) format and must be
+        # refused — without conflating genuine I/O or sharding errors from
+        # the restore itself with layout incompatibility
+        try:
+            meta = self._mgr.item_metadata(step_id)
+        except Exception:  # noqa: BLE001 — metadata probe is best-effort
+            meta = None
+        meta_tree = getattr(meta, "tree", meta)
+        if meta_tree is not None and "layout_version" not in meta_tree:
+            raise ValueError(
+                f"checkpoint step {step_id} in {self._dir} does not carry a "
+                "layout_version stamp (it predates the versioned format).  "
+                "Parameter LAYOUT changes (fused-QKV reorder, fat-line "
+                "packing) restore without shape errors but scramble values, "
+                "so resuming it is refused.  Retrain, or convert the "
+                "checkpoint offline."
+            )
+        abstract = {
+            "layout_version": jax.ShapeDtypeStruct((), np.int32),
+            "state": jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like),
+        }
         restored = self._mgr.restore(
             step_id, args=ocp.args.StandardRestore(abstract)
         )
-        return step_id, _merge_static(state_like, restored)
+        found = int(np.asarray(restored["layout_version"]))
+        if found != LAYOUT_VERSION:
+            raise ValueError(
+                f"checkpoint step {step_id} in {self._dir} was written with "
+                f"storage-layout version {found}, but this build uses "
+                f"{LAYOUT_VERSION}.  The layouts are not value-compatible "
+                "(see tdfo_tpu/train/checkpoint.py LAYOUT_VERSION history); "
+                "resuming would silently scramble parameters, so it is "
+                "refused.  Retrain, or convert the checkpoint offline."
+            )
+        return step_id, _merge_static(state_like, restored["state"])
 
     def close(self) -> None:
         self._mgr.close()

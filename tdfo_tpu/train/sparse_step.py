@@ -158,40 +158,57 @@ def make_sparse_train_step(
                 table = state.tables[tname]
                 d = coll.array_embedding_dim(tname)
                 fat = table.ndim == 3
-                lay = coll.fat_layout_for(tname) if fat else None
-                r = lay.r if fat else 1
-                all_ids, sizes, bound = _concat_ids(feats, ids, rows_per_line=r)
+                all_ids, sizes, bound = _concat_ids(feats, ids)
                 total = all_ids.shape[0]
                 # +1 slack: negative (padding) ids dedupe to ONE sentinel
                 # slot beyond the real-id bound; without it the expand would
                 # clamp the sentinel seg onto a real row's slot
                 cap = (-(-(bound + 1) // 8) * 8) if bound + 1 < total else None
-                uids, seg, valid = dedupe_ids(
-                    all_ids.astype(jnp.int32), capacity=cap, max_distinct=cap,
-                    rows_per_line=r,
-                )
                 if fat:
-                    # gather whole packed LINES straight off the 3D array
-                    # (the fast TPU gather — reshaping the table to a row
-                    # view materialises a multi-GB copy), then slice the R
-                    # slot rows out of the small gathered block.  ``seg``
-                    # already indexes the C x R line-slot space.  Sentinel
-                    # lines clamp to line 0 slot 0 = row 0, exactly like
-                    # the default lookup's clip of out-of-contract ids.
-                    lines = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
-                    flat = lines.reshape(lines.shape[0], lay.tiles * 128)
-                    rows = jnp.concatenate(
-                        [flat[:, None, s * lay.w: s * lay.w + d]
-                         for s in range(r)], axis=1,
-                    ).reshape(lines.shape[0] * r, d)
+                    # routed fat-line flow: ONE sort yields the row-level
+                    # expand key AND the line grouping.  Forward: gather
+                    # whole packed LINES straight off the 3D array (the
+                    # fast TPU gather — reshaping the table to a row view
+                    # materialises a multi-GB copy), expand per distinct
+                    # row from the SMALL gathered block, slot-select, then
+                    # expand per batch position.  Sentinel rows resolve to
+                    # line 0 slot 0 = row 0, the default lookup's clip.
+                    from tdfo_tpu.ops.sparse import dedupe_rows_and_lines
+
+                    lay = coll.fat_layout_for(tname)
+                    _, _, bound_l = _concat_ids(feats, ids,
+                                                rows_per_line=lay.r)
+                    cap_r = cap if cap is not None else total
+                    cap_l = min(cap_r, -(-(bound_l + 1) // 8) * 8)
+                    seg, ulines, row_lidx, row_slot = dedupe_rows_and_lines(
+                        all_ids.astype(jnp.int32), capacity_rows=cap_r,
+                        capacity_lines=cap_l, rows_per_line=lay.r,
+                    )
+                    oob = jnp.iinfo(jnp.int32).max
+                    lines = jnp.take(
+                        table, jnp.where(ulines < oob, ulines, 0), axis=0)
+                    flat = lines.reshape(cap_l, lay.tiles * 128)
+                    rowlines = jnp.take(
+                        flat, jnp.minimum(row_lidx, cap_l - 1), axis=0)
+                    rows = rowlines[:, :d]
+                    for s in range(1, lay.r):
+                        rows = jnp.where(
+                            (row_slot == s)[:, None],
+                            rowlines[:, s * lay.w: s * lay.w + d], rows)
+                    dedup_ctx[tname] = ("routed", ulines, seg, row_lidx,
+                                        row_slot, lines)
                 else:
+                    uids, seg, valid = dedupe_ids(
+                        all_ids.astype(jnp.int32), capacity=cap,
+                        max_distinct=cap,
+                    )
                     rows = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
+                    dedup_ctx[tname] = ("rows", uids, seg, valid)
                 off = 0
                 for f, n_f in zip(feats, sizes):
                     e = jnp.take(rows, seg[off:off + n_f], axis=0)
                     embs[f] = e.reshape(*ids[f].shape, e.shape[-1])
                     off += n_f
-                dedup_ctx[tname] = (uids, seg, valid)
         else:
             embs = coll.lookup(state.tables, ids, mode=mode)
         loss, (g_dense, g_embs) = jax.value_and_grad(
@@ -226,27 +243,25 @@ def make_sparse_train_step(
                     and not coll.needs_shard_map_update(tname)):
                 # shared-dedupe fast path: segment-sum by the forward's seg
                 # and feed the optimizer tiers directly (no second sort)
-                uids, seg, valid = dedup_ctx[tname]
+                ctx = dedup_ctx[tname]
                 d_t = coll.array_embedding_dim(tname)
-                if state.tables[tname].ndim == 3:
-                    # line-level operands (seg spans the C x R slot space):
-                    # straight into the in-place DMA kernel, zero scatters
-                    lay = coll.fat_layout_for(tname)
-                    c = uids.shape[0]
-                    g_slots = jax.ops.segment_sum(
+                if ctx[0] == "routed":
+                    # row-level segment-sum (the cheap space) + in-kernel
+                    # routing: the whole table update has no XLA scatter,
+                    # and the kernel reuses the forward's line gather
+                    _, ulines, seg, row_lidx, row_slot, lines = ctx
+                    g_u = jax.ops.segment_sum(
                         all_grads.astype(jnp.float32), seg,
-                        num_segments=c * lay.r,
-                    )
-                    touched = jax.ops.segment_sum(
-                        jnp.ones_like(seg, jnp.float32), seg,
-                        num_segments=c * lay.r,
+                        num_segments=row_lidx.shape[0],
                     )
                     new_tables[tname], new_slots[tname] = (
-                        state.sparse_opt.update_unique_lines(
-                            state.tables[tname], state.slots[tname], uids,
-                            g_slots, touched, embedding_dim=d_t,
+                        state.sparse_opt.update_routed(
+                            state.tables[tname], state.slots[tname], ulines,
+                            g_u, row_lidx, row_slot, lines,
+                            embedding_dim=d_t,
                         ))
                     continue
+                _, uids, seg, valid = ctx
                 g_u = jax.ops.segment_sum(
                     all_grads, seg, num_segments=uids.shape[0]
                 )

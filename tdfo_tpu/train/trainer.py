@@ -220,6 +220,7 @@ class Trainer:
         self.mesh = make_mesh(config.mesh)
         self.logger = MetricLogger(log_dir or config.checkpoint_dir)
         self._ckpt = None
+        self._a2a_overflow = None  # alltoall dropped-id diagnostic (jitted)
         self._map_streams: dict = {}  # streaming=false table cache
         if config.checkpoint_dir:
             from tdfo_tpu.train.checkpoint import CheckpointManager
@@ -416,6 +417,14 @@ class Trainer:
         self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
         self._eval_schema = _ctr_eval_schema(cat_cols, cont_cols)
         features, mode = list(coll.features()), cfg.lookup_mode
+        if (mode == "alltoall" and cfg.a2a_capacity_factor
+                and cfg.steps_per_execution == 1):
+            # a finite capacity factor silently zeroes overflowed ids under
+            # skew: surface the dropped-id count in the JSONL log
+            # (steps_per_execution > 1 logs stacked chunks whose leading dim
+            # is steps, not batch — skipped there)
+            self._a2a_overflow = jax.jit(lambda st, bt: coll.a2a_overflow(
+                st.tables, {f: bt[f] for f in features}))
 
         def sparse_logits(state, batch):
             embs = coll.lookup(state.tables, {f: batch[f] for f in features}, mode=mode)
@@ -516,6 +525,13 @@ class Trainer:
             )
         self._train_auc_enabled = False  # AUC is a binary-CTR metric
         self._dropout_rng = jax.random.key(cfg.seed + 1)
+        if (cfg.lookup_mode == "alltoall" and cfg.a2a_capacity_factor
+                and not cfg.jagged and cfg.steps_per_execution == 1):
+            # surface the capacity knob's silent failure mode (dropped ids
+            # -> zero vectors) in the JSONL log
+            seq_coll = self.coll
+            self._a2a_overflow = jax.jit(lambda st, bt: seq_coll.a2a_overflow(
+                st.tables, {"item": bt["item"]}))
         self._stream_cls = ParquetStream  # seq ETL writes parquet only
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
         self._eval_pattern = str(Path("parquet_bert4rec") / cfg.eval_data)
@@ -685,7 +701,13 @@ class Trainer:
                 jax.profiler.stop_trace()
                 profiled = False
             if n_steps >= next_log:
-                self.logger.log(epoch=epoch, step=n_steps, train_loss=float(loss))
+                rec = dict(epoch=epoch, step=n_steps, train_loss=float(loss))
+                if self._a2a_overflow is not None:
+                    # ids dropped by the finite a2a capacity THIS batch
+                    # (zero vectors under skew — watch for quality decay)
+                    rec["a2a_overflow_ids"] = int(
+                        self._a2a_overflow(self.state, batch))
+                self.logger.log(**rec)
                 # chunked counting can jump n_steps past several intervals;
                 # advance past n_steps so each interval logs at most once
                 next_log = n_steps + cfg.log_every_n_steps

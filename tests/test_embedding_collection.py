@@ -216,6 +216,20 @@ def test_alltoall_capacity_factor_drops_overflow(mesh8):
     n_zero = int((out2 == 0).all(axis=1).sum())
     assert n_exact >= 16 and n_zero > 0 and n_exact + n_zero == 64, (n_exact, n_zero)
 
+    # the observability counter reports EXACTLY the dropped-id count the
+    # lookup produced — for any id distribution
+    count = jax.jit(lambda t, i: coll2.a2a_overflow(t, {"item": i}))
+    assert int(count(tables, skew)) == n_zero
+    out_bal = np.asarray(jax.jit(
+        lambda t, i: coll2.lookup(t, {"item": i}, mode="alltoall")["item"]
+    )(tables, balanced))
+    assert int(count(tables, balanced)) == int(
+        (out_bal == 0).all(axis=1).sum())
+    # factor 2.0 never overflows these batches: counter stays 0
+    exact_count = jax.jit(lambda t, i: coll.a2a_overflow(t, {"item": i}))
+    assert int(exact_count(tables, skew)) == 0
+    assert int(exact_count(tables, balanced)) == 0
+
 
 class TestFatStacking:
     """Fused fat-row tables sharing (dim, sharding) stack into ONE array —

@@ -377,6 +377,63 @@ def test_flash_backward_padded_seq_len():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-4, atol=1e-4)
 
 
+class TestFatRouted:
+    """The routed fat-line path: dedupe_rows_and_lines + row-level
+    segment-sum + fat_apply_routed (in-kernel operand routing reusing the
+    forward's line gather) must reproduce the plain-table formulations for
+    every kind, including padding ids, shared lines, and multi-block."""
+
+    @pytest.mark.parametrize("kind,d", [
+        ("rowwise_adagrad", 16), ("adam", 64), ("sgd", 8), ("adagrad", 16),
+    ])
+    def test_matches_plain_path(self, kind, d):
+        from tdfo_tpu.ops.sparse import (
+            SparseOptimizer,
+            dedupe_rows_and_lines,
+            fat_apply_routed,
+        )
+
+        rng = np.random.default_rng(17)
+        v, b = 530, 700  # > 128 lines at d=16 kinds -> multi-block
+        lr, wd = 1e-2, 1e-3
+        lay = line_layout(d, kind)
+        table = jnp.asarray(rng.standard_normal((v, d)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(-1, v, b).astype(np.int32))
+        grads = jnp.asarray(rng.standard_normal((b, d)).astype(np.float32))
+        grads = jnp.where((ids >= 0)[:, None], grads, 0.0)
+        opt = SparseOptimizer(kind=kind, lr=lr, weight_decay=wd,
+                              small_vocab_threshold=0)
+        t_ref, _ = opt.update(table, opt.init(table), ids, grads)
+
+        seg, ulines, row_lidx, row_slot = dedupe_rows_and_lines(
+            ids, capacity_rows=b, capacity_lines=b, rows_per_line=lay.r)
+        fat = fat_pack(table, kind=kind)
+        oob = jnp.iinfo(jnp.int32).max
+        lines = jnp.take(fat, jnp.where(ulines < oob, ulines, 0), axis=0)
+        # forward parity: expanded rows == table[ids] (negatives -> row 0)
+        flat = lines.reshape(b, lay.tiles * 128)
+        rows = jnp.take(flat, jnp.minimum(row_lidx, b - 1), axis=0)[:, :d]
+        for s in range(1, lay.r):
+            rl = jnp.take(flat, jnp.minimum(row_lidx, b - 1), axis=0)
+            rows = jnp.where((row_slot == s)[:, None],
+                             rl[:, s * lay.w: s * lay.w + d], rows)
+        np.testing.assert_array_equal(
+            np.asarray(jnp.take(rows, seg, axis=0)),
+            np.asarray(jnp.take(table, jnp.maximum(ids, 0), axis=0)))
+
+        g_u = jax.ops.segment_sum(grads.astype(jnp.float32), seg,
+                                  num_segments=b)
+        slots = (jnp.zeros((), jnp.int32),) if kind == "adam" else ()
+        for interpret in (True, False):  # kernel (interpret) and XLA paths
+            t_new, _ = fat_apply_routed(
+                fat, slots, ulines, g_u, row_lidx, row_slot, lines,
+                embedding_dim=d, kind=kind, lr=lr, weight_decay=wd,
+                interpret=interpret)
+            got = fat_unpack(t_new, lay, rows=v)[0]
+            np.testing.assert_allclose(np.asarray(got), np.asarray(t_ref),
+                                       rtol=1e-5, atol=1e-6)
+
+
 @pytest.mark.parametrize("u", [129, 400])
 def test_fat_multi_block_pipeline(u):
     """>128 touched lines forces multiple grid steps, exercising the

@@ -386,6 +386,54 @@ def test_preempted_save_does_not_poison_resume(prepared_dir, tmp_path):
     assert tr2._ckpt.latest_step() == 1
 
 
+def test_checkpoint_layout_version_guard(tmp_path):
+    """Restoring a checkpoint with a foreign (or missing) storage-layout
+    stamp must REFUSE with a clear error: parameter layout changes (the
+    round-4 fused-QKV reorder, the round-5 fat-line packing) restore
+    without shape errors but scramble values — the exact silent-corruption
+    hazard the stamp exists to block."""
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    import pytest
+
+    from tdfo_tpu.train import checkpoint as ckpt_mod
+    from tdfo_tpu.train.checkpoint import LAYOUT_VERSION, CheckpointManager
+
+    state = {"w": jnp.arange(6.0).reshape(2, 3)}
+
+    # roundtrip at the current version works and preserves values
+    mgr = CheckpointManager(tmp_path / "ok")
+    mgr.save(0, state)
+    step, restored = mgr.restore(state)
+    assert step == 0
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    mgr.close()
+
+    # legacy checkpoint (no stamp — pre-versioning format): refused
+    legacy = ocp.CheckpointManager(
+        (tmp_path / "legacy").absolute(),
+        options=ocp.CheckpointManagerOptions(create=True))
+    legacy.save(0, args=ocp.args.StandardSave(state))
+    legacy.wait_until_finished()
+    legacy.close()
+    mgr2 = CheckpointManager(tmp_path / "legacy")
+    with pytest.raises(ValueError, match="layout_version"):
+        mgr2.restore(state)
+    mgr2.close()
+
+    # foreign version stamp: refused with both versions named
+    mgr3 = CheckpointManager(tmp_path / "old")
+    try:
+        ckpt_mod.LAYOUT_VERSION = LAYOUT_VERSION - 1
+        mgr3.save(0, state)
+    finally:
+        ckpt_mod.LAYOUT_VERSION = LAYOUT_VERSION
+    with pytest.raises(ValueError, match="layout version"):
+        mgr3.restore(state)
+    mgr3.close()
+
+
 def test_bert4rec_dedup_lookup_matches_default(prepared_dir):
     """dedup_lookup on the sequence family ([B, T] ids, fat item table,
     model-parallel mesh): same metrics as the default path."""
