@@ -106,7 +106,18 @@ def get_book_features(data_dir: Path) -> tuple[pd.DataFrame, dict[str, int]]:
     book_map["book_original_id"] = book_map["book_original_id"].astype(str)
     size_map["item"] = int(len(book_map))
 
-    books = pd.read_json(data_dir / "goodreads_books.json", lines=True, dtype=False)
+    # STREAM the ndjson in bounded chunks, keeping only the feature columns
+    # (the reference streams this file too: polars collect(streaming=True),
+    # jax-flax/preprocessing.py:53 — a full read of the 2 GB books dump
+    # would spike peak RSS by the whole raw payload)
+    keep = ["book_id", "language_code", "is_ebook", "average_rating",
+            "format", "publisher", "num_pages", "publication_year"]
+    chunks = []
+    with pd.read_json(data_dir / "goodreads_books.json", lines=True,
+                      dtype=False, chunksize=100_000) as reader:
+        for chunk in reader:
+            chunks.append(chunk[[c for c in keep if c in chunk.columns]])
+    books = pd.concat(chunks, ignore_index=True)
     books = books.rename(columns={
         "book_id": "book_original_id", "language_code": "language",
         "average_rating": "avg_rating", "publication_year": "pub_year",

@@ -389,9 +389,17 @@ class TFRecordStream(ParquetStream):
             if sizes is not None and p.name in sizes:
                 for name, n in sizes.items():
                     self._row_counts[str(p.parent / name)] = n
-            else:  # no per-shard sidecar: count by scanning once
+            else:
+                # no per-shard sidecar: count by scanning once, then CACHE
+                # the count to a sidecar so later epochs (and other runs /
+                # hosts) never rescan the whole gzip stream again
                 self._row_counts[path] = sum(
                     1 for _ in read_tfrecord_records(path, self.compression)
+                )
+                from tdfo_tpu.data.tfrecord import write_shard_sizes_entry
+
+                write_shard_sizes_entry(
+                    p.parent, prefix, p.name, self._row_counts[path]
                 )
         return self._row_counts[path]
 

@@ -31,9 +31,22 @@ def write_synthetic_goodreads(
     n_books: int = 300,
     interactions_per_user: tuple[int, int] = (5, 60),
     seed: int = 0,
+    signal: float = 0.0,
 ) -> Path:
     """Write raw files under ``data_dir``; returns the dir.  Zipf-ish item
-    popularity so popularity-weighted negative sampling has signal."""
+    popularity so popularity-weighted negative sampling has signal.
+
+    ``signal`` in [0, 1] plants LEARNABLE structure (default 0 keeps the
+    historical pure-noise fixtures byte-identical): books fall into latent
+    clusters, each user has a theme cluster, themed draws are preferred
+    with probability ``signal``, and ratings are biased up on theme
+    matches.  The CTR label (rating >= 4) then correlates with the
+    user x item embedding interaction and item sequences are
+    theme-coherent — so converged eval AUC / Recall@K measurably beat the
+    0.5 / popularity floors (the quality-parity evidence the reference
+    establishes with real Goodreads data, torchrec/train.py:143-144,
+    jax-flax/train_dp.py:219-245).
+    """
     data_dir = Path(data_dir)
     data_dir.mkdir(parents=True, exist_ok=True)
     rng = np.random.default_rng(seed)
@@ -44,13 +57,29 @@ def write_synthetic_goodreads(
     # map row count, so an id == n_users would be out of bounds). ---
     item_weights = 1.0 / np.arange(1, n_books + 1) ** 0.8
     item_weights /= item_weights.sum()
+    n_clusters = 8
+    book_cluster = np.arange(n_books) % n_clusters
     rows = []
     for u in range(n_users):
         k = int(rng.integers(*interactions_per_user))
         k = min(k, n_books)
-        books = rng.choice(np.arange(n_books), size=k, replace=False,
-                           p=item_weights)
-        ratings = rng.integers(0, 6, size=k)
+        if signal > 0.0:
+            theme = int(rng.integers(0, n_clusters))
+            w = item_weights * np.where(
+                book_cluster == theme, 1.0 + 19.0 * signal, 1.0)
+            w /= w.sum()
+            books = rng.choice(np.arange(n_books), size=k, replace=False, p=w)
+            match = book_cluster[books] == theme
+            # themed books rate high, off-theme low (plus noise): the
+            # rating>=4 label becomes predictable from (user, item)
+            base = np.where(match, 4.3, 1.7)
+            ratings = np.clip(np.round(
+                base + rng.normal(0.0, 1.2 * (1.0 - signal) + 0.6, size=k)
+            ), 0, 5).astype(int)
+        else:
+            books = rng.choice(np.arange(n_books), size=k, replace=False,
+                               p=item_weights)
+            ratings = rng.integers(0, 6, size=k)
         for b, r in zip(books, ratings):
             rows.append((u, int(b), int(rng.integers(0, 2)), int(r),
                          int(rng.integers(0, 2))))

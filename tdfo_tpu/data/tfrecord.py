@@ -381,7 +381,10 @@ def read_size_sidecar(write_dir: str | Path, prefix: str) -> int | None:
     if not p.exists():
         return None
     with open(p) as f:
-        return int(json.load(f)["data_size"])
+        # sidecars grown by write_shard_sizes_entry after fallback scans
+        # carry shard_sizes only — no fabricated total
+        size = json.load(f).get("data_size")
+    return None if size is None else int(size)
 
 
 def read_shard_sizes(write_dir: str | Path, prefix: str) -> dict[str, int] | None:
@@ -392,6 +395,38 @@ def read_shard_sizes(write_dir: str | Path, prefix: str) -> dict[str, int] | Non
     with open(p) as f:
         sizes = json.load(f).get("shard_sizes")
     return {k: int(v) for k, v in sizes.items()} if sizes else None
+
+
+def write_shard_sizes_entry(write_dir: str | Path, prefix: str,
+                            shard_name: str, n_rows: int) -> None:
+    """Record one shard's row count into the sidecar (creating it if
+    absent) — the loader calls this after a fallback full scan of a shard
+    whose sidecar is missing, so the O(dataset) rescan happens at most once
+    per shard ever, not once per epoch-budget computation per host.  Best
+    effort: a read-only data dir keeps the in-memory count only."""
+    import os
+
+    p = Path(write_dir) / f"{prefix}_data_size.json"
+    try:
+        doc = {}
+        if p.exists():
+            with open(p) as f:
+                doc = json.load(f)
+        # only the per-shard map is maintained here — "data_size" (the
+        # dataset total) stays untouched: a partially-scanned directory
+        # must not masquerade as a complete count
+        sizes = doc.setdefault("shard_sizes", {})
+        sizes[shard_name] = int(n_rows)
+        # per-process tmp name + atomic replace: concurrent hosts hitting
+        # the fallback scan together must never interleave into one file
+        tmp = p.with_suffix(f".json.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        tmp.replace(p)
+    except (OSError, ValueError):
+        # best effort: unwritable dirs or a concurrently-garbled sidecar
+        # keep the in-memory count only
+        pass
 
 
 def stack_example_rows(

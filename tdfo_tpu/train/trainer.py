@@ -140,10 +140,15 @@ def _make_ctr_eval_accum(logits_fn: Callable):
         logits = logits_fn(state, batch)
         labels = batch["label"].astype(jnp.float32)
         loss_vec = optax.sigmoid_binary_cross_entropy(logits, labels)
+        # non-finite logits (mixed-precision overflow) must not fold a
+        # backend-defined NaN->bin cast into the headline eval AUC
+        ok = jnp.isfinite(logits)
         return {
             "loss_sum": acc["loss_sum"] + (loss_vec * w).sum(),
             "w_sum": acc["w_sum"] + w.sum(),
-            "auc": acc["auc"].update(labels, jax.nn.sigmoid(logits), w),
+            "auc": acc["auc"].update(
+                labels, jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
+                w * ok.astype(jnp.float32)),
         }
 
     return accum
@@ -162,8 +167,13 @@ def _wrap_auc_step(inner, *, donate_state: bool = True):
 
     def step(state, batch, acc: AUC):
         state, (loss, logits) = inner(state, batch)
+        # mixed-precision overflow steps can emit non-finite logits; a
+        # NaN->int32 histogram-bin cast is backend-defined, so weight those
+        # samples out of the streaming AUC instead of folding garbage in
+        ok = jnp.isfinite(logits)
         acc = acc.update(batch["label"].astype(jnp.float32),
-                         jax.nn.sigmoid(logits))
+                         jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
+                         ok.astype(jnp.float32))
         return state, loss, acc
 
     return jax.jit(step, donate_argnums=(0,) if donate_state else ())
@@ -177,8 +187,10 @@ def _wrap_auc_multi_step(inner, *, donate_state: bool = True):
         def body(carry, batch):
             st, a = carry
             st, (loss, logits) = inner(st, batch)
+            ok = jnp.isfinite(logits)  # see _wrap_auc_step
             a = a.update(batch["label"].astype(jnp.float32),
-                         jax.nn.sigmoid(logits))
+                         jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
+                         ok.astype(jnp.float32))
             return (st, a), loss
 
         (state, acc), losses = jax.lax.scan(body, (state, acc), stack)

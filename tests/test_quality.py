@@ -1,0 +1,68 @@
+"""Quality-parity convergence floors (the reference's de-facto acceptance
+test is converged in-loop metrics on real Goodreads data:
+jax-flax/train_dp.py:219-245 eval ROC-AUC, torchrec/train.py:143-144
+Recall@K/NDCG@K).  Reduced-scale versions of tools/quality_run.py (whose
+full trajectories are committed under docs/quality/): the signal-bearing
+synthetic fixtures make the metrics MEAN something — eval AUC must clear
+the 0.5 noise floor decisively, and Bert4Rec's post-training ranking must
+decisively beat its own pre-training validation floor."""
+
+# (no slow-marker infra in this suite: these run unconditionally)
+import json
+
+import pytest
+
+from tdfo_tpu.core.config import read_configs
+from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+from tdfo_tpu.data.seq_preprocessing import run_seq_preprocessing
+from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+from tdfo_tpu.train.trainer import Trainer
+
+
+def test_twotower_converges_above_noise_floor(tmp_path):
+    d = tmp_path / "gr"
+    write_synthetic_goodreads(d, n_users=800, n_books=320,
+                              interactions_per_user=(30, 60), seed=5,
+                              signal=0.85)
+    size_map = run_ctr_preprocessing(d)
+    cfg = read_configs(
+        None, data_dir=d, model="twotower", model_parallel=True,
+        n_epochs=10, learning_rate=3e-3, weight_decay=1e-3, embed_dim=8,
+        per_device_train_batch_size=64, per_device_eval_batch_size=64,
+        shuffle_buffer_size=20_000, log_every_n_steps=10_000,
+        size_map=size_map,
+    )
+    metrics = Trainer(cfg).fit()
+    # pure-noise data pins eval AUC at ~0.5 forever; the themed fixtures
+    # support ~0.6+ at this scale (docs/quality: 0.66 at 15 epochs)
+    assert metrics["auc"] >= 0.56, metrics
+
+
+def test_bert4rec_beats_pretrain_ranking_floor(tmp_path):
+    d = tmp_path / "gr"
+    write_synthetic_goodreads(d, n_users=300, n_books=320,
+                              interactions_per_user=(30, 60), seed=7,
+                              signal=0.85)
+    stats = run_seq_preprocessing(d, max_len=16, sliding_step=8, seed=7)
+    cfg = read_configs(
+        None, data_dir=d, model="bert4rec", model_parallel=True,
+        n_epochs=10, learning_rate=3e-3, embed_dim=32, n_heads=2,
+        n_layers=2, max_len=16, sliding_step=8,
+        per_device_train_batch_size=32, per_device_eval_batch_size=32,
+        shuffle_buffer_size=20_000, log_every_n_steps=10_000,
+        size_map={"n_items": stats["n_items"]},
+    )
+    log_dir = tmp_path / "logs"
+    metrics = Trainer(cfg, log_dir=log_dir).fit()
+    # the pre-training validation (epoch -1, torchrec/train.py:159 parity)
+    # is the untrained floor of the SAME protocol — convergence must beat
+    # it decisively, and clear an absolute floor well above it
+    pre = None
+    for line in open(log_dir / "metrics.jsonl"):
+        rec = json.loads(line)
+        if rec.get("epoch") == -1 and "Recall@10" in rec:
+            pre = rec
+    assert pre is not None
+    assert metrics["Recall@10"] >= 0.30, metrics
+    assert metrics["Recall@10"] >= pre["Recall@10"] + 0.10, (pre, metrics)
+    assert metrics["NDCG@10"] >= pre["NDCG@10"] + 0.05, (pre, metrics)

@@ -192,6 +192,42 @@ class TestTFRecordStream:
         b = next(iter(stream))
         assert {"user_id", "item_id", "label", "avg_rating"} <= set(b)
 
+    def test_missing_sidecar_scan_is_cached(self, tmp_path):
+        """With no row-count sidecar the loader falls back to a full gzip
+        scan — ONCE: the counts are cached back to the sidecar so later
+        epoch-budget computations (and other runs) never rescan."""
+        import json as _json
+
+        from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+        from tdfo_tpu.data.loader import TFRecordStream, resolve_files
+        from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+
+        d = tmp_path / "gr"
+        write_synthetic_goodreads(d, n_users=40, n_books=60,
+                                  interactions_per_user=(8, 16), seed=3)
+        run_ctr_preprocessing(d, write_format="tfrecord", file_num=2)
+        sidecar = d / "tfrecord" / "train_data_size.json"
+        with open(sidecar) as f:
+            full = _json.load(f)
+        sidecar.unlink()  # simulate a dataset delivered without the sidecar
+
+        files = resolve_files(d, "tfrecord/train_part_*.tfrecord")
+        stream = TFRecordStream(files, batch_size=16, buffer_size=32,
+                                drop_last=True, process_index=0,
+                                process_count=1)
+        n1 = stream.max_batches_per_host()  # triggers the fallback scans
+        assert n1 > 0
+        with open(sidecar) as f:
+            doc = _json.load(f)
+        assert doc["shard_sizes"] == full["shard_sizes"]
+        assert "data_size" not in doc  # partial totals never fabricated
+
+        # a fresh stream reads the cached counts (same budget, no rescan)
+        stream2 = TFRecordStream(files, batch_size=16, buffer_size=32,
+                                 drop_last=True, process_index=0,
+                                 process_count=1)
+        assert stream2.max_batches_per_host() == n1
+
     def test_stream_trains_twotower(self, tfr_dir):
         import jax
         import jax.numpy as jnp
