@@ -190,6 +190,11 @@ class Config:
     checkpoint_every_n_epochs: int = 10
     log_every_n_steps: int = 100
     profile: bool = False
+    # mirror every logged scalar into a TensorBoard events file next to the
+    # JSONL (tensorflow2/train_ps.py:154 TensorBoard-callback parity, made
+    # framework-wide; TF-free writer, tdfo_tpu/utils/tensorboard.py):
+    # `tensorboard --logdir <checkpoint_dir>` shows train/eval curves
+    tensorboard: bool = False
 
     # --- preprocessing handshake ---
     size_map: Mapping[str, int] = field(default_factory=dict)

@@ -54,11 +54,21 @@ class MetricLogger:
     """stdout + JSONL metrics (the observability layer the reference lacks —
     its closest analogue is tqdm bars + prints, SURVEY.md §5.5)."""
 
-    def __init__(self, log_dir: str | Path | None = None):
+    def __init__(self, log_dir: str | Path | None = None,
+                 tensorboard: bool = False):
         self._f = None
+        self._tb = None
+        self._n = 0
         if log_dir is not None and jax.process_index() == 0:
             Path(log_dir).mkdir(parents=True, exist_ok=True)
             self._f = open(Path(log_dir) / "metrics.jsonl", "a")
+            if tensorboard:
+                # TF-free tfevents mirror of every scalar (the PS recipe's
+                # TensorBoard callback, tensorflow2/train_ps.py:154, made
+                # framework-wide): `tensorboard --logdir` shows the curves
+                from tdfo_tpu.utils.tensorboard import TBScalarWriter
+
+                self._tb = TBScalarWriter(log_dir)
 
     def log(self, **record: Any) -> None:
         record.setdefault("time", time.time())
@@ -71,10 +81,26 @@ class MetricLogger:
             if self._f is not None:
                 self._f.write(json.dumps(record) + "\n")
                 self._f.flush()
+            if self._tb is not None:
+                scalars = {
+                    k: float(v) for k, v in record.items()
+                    if k not in ("time", "step", "epoch", "global_step")
+                    and isinstance(v, (int, float))
+                }
+                # per-tag x-axis: run-global step when the caller provides
+                # one (per-epoch `step` resets and would fold curves back),
+                # else epoch, else a monotone event counter
+                step = record.get(
+                    "global_step", record.get("epoch", self._n))
+                self._tb.scalars(int(step), scalars,
+                                 wall_time=record["time"])
+            self._n += 1
 
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
+        if self._tb is not None:
+            self._tb.close()
 
 
 def pad_batch(batch: dict[str, np.ndarray], size: int) -> tuple[dict[str, np.ndarray], np.ndarray]:
@@ -230,8 +256,10 @@ class Trainer:
                 "refuse to silently train a TPU config elsewhere)"
             )
         self.mesh = make_mesh(config.mesh)
-        self.logger = MetricLogger(log_dir or config.checkpoint_dir)
+        self.logger = MetricLogger(log_dir or config.checkpoint_dir,
+                                   tensorboard=config.tensorboard)
         self._ckpt = None
+        self._logged_steps = 0  # run-global step counter for TB x-axes
         self._a2a_overflow = None  # alltoall dropped-id diagnostic (jitted)
         self._map_streams: dict = {}  # streaming=false table cache
         if config.checkpoint_dir:
@@ -719,6 +747,9 @@ class Trainer:
                     # (zero vectors under skew — watch for quality decay)
                     rec["a2a_overflow_ids"] = int(
                         self._a2a_overflow(self.state, batch))
+                # TB charts need a run-global x (per-epoch `step` resets,
+                # which would fold multi-epoch curves back on themselves)
+                rec["global_step"] = self._logged_steps + n_steps
                 self.logger.log(**rec)
                 # chunked counting can jump n_steps past several intervals;
                 # advance past n_steps so each interval logs at most once
@@ -728,6 +759,7 @@ class Trainer:
             jax.block_until_ready(loss_sum)
             jax.profiler.stop_trace()
         dt = time.perf_counter() - t0
+        self._logged_steps += n_steps
         avg = float(loss_sum) / n_steps if n_steps else 0.0
         extra: dict[str, float] = {}
         if train_auc is not None and n_steps:

@@ -1,0 +1,102 @@
+"""TensorBoard-compatible scalar event writer — TF-free.
+
+Reference parity: the PS recipe ships a ``tf.keras.callbacks.TensorBoard``
+callback (``tensorflow2/train_ps.py:154``); here the capability is
+framework-wide — ``MetricLogger`` mirrors every scalar it logs into a
+``tfevents`` file when the ``tensorboard`` config knob is on, so
+``tensorboard --logdir <checkpoint_dir>`` shows train/eval curves for any
+model family and regime.
+
+The wire format is two small pieces this repo already implements for
+TFRecord (``tdfo_tpu/data/tfrecord.py``): protobuf primitives (varints +
+length-delimited fields) and the length/masked-crc32c record framing —
+an Event proto is just::
+
+    Event { double wall_time = 1; int64 step = 2;
+            string file_version = 3;     # first record only
+            Summary summary = 5; }
+    Summary { repeated Value value = 1; }
+    Value   { string tag = 1; float simple_value = 2; }
+
+Cross-validated against TensorFlow's own ``summary_iterator`` in
+``tests/test_tensorboard.py`` (TF happens to be in the test image; the
+framework itself never imports it).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from pathlib import Path
+
+from tdfo_tpu.data.tfrecord import _ld as _bytes_field
+from tdfo_tpu.data.tfrecord import _masked_crc, _varint
+
+__all__ = ["TBScalarWriter"]
+
+
+def _field(num: int, wire: int) -> bytes:
+    return _varint((num << 3) | wire)
+
+
+def _double_field(num: int, v: float) -> bytes:
+    return _field(num, 1) + struct.pack("<d", v)
+
+
+def _float_field(num: int, v: float) -> bytes:
+    return _field(num, 5) + struct.pack("<f", v)
+
+
+def _varint_field(num: int, v: int) -> bytes:
+    return _field(num, 0) + _varint(v & (2**64 - 1))  # int64 two's complement
+
+
+def _event(wall_time: float, *, step: int | None = None,
+           file_version: str | None = None,
+           scalars: dict[str, float] | None = None) -> bytes:
+    out = _double_field(1, wall_time)
+    if step is not None:
+        out += _varint_field(2, step)
+    if file_version is not None:
+        out += _bytes_field(3, file_version.encode())
+    if scalars:
+        summary = b"".join(
+            _bytes_field(1, _bytes_field(1, tag.encode())
+                         + _float_field(2, float(v)))
+            for tag, v in scalars.items()
+        )
+        out += _bytes_field(5, summary)
+    return out
+
+
+class TBScalarWriter:
+    """Append scalar events to ``events.out.tfevents.<ts>.<host>``."""
+
+    def __init__(self, log_dir: str | Path):
+        log_dir = Path(log_dir)
+        log_dir.mkdir(parents=True, exist_ok=True)
+        name = f"events.out.tfevents.{time.time():.6f}.{socket.gethostname()}"
+        self._f = open(log_dir / name, "ab")
+        self._write(_event(time.time(), file_version="brain.Event:2"))
+
+    def _write(self, payload: bytes) -> None:
+        hdr = struct.pack("<Q", len(payload))
+        self._f.write(hdr)
+        self._f.write(struct.pack("<I", _masked_crc(hdr)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+        self._f.flush()
+
+    def scalars(self, step: int, values: dict[str, float],
+                wall_time: float | None = None) -> None:
+        if not values:
+            return
+        # negative steps (the bert4rec pre-training validation at epoch -1)
+        # encode fine as two's-complement int64 and keep the untrained
+        # baseline point distinct from epoch 0
+        self._write(_event(wall_time if wall_time is not None else time.time(),
+                           step=int(step), scalars=values))
+
+    def close(self) -> None:
+        self._f.close()
