@@ -18,12 +18,18 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11 — tomli is the same parser/API
+    import tomli as tomllib  # type: ignore[no-redef]
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Mapping
 
-__all__ = ["Config", "MeshSpec", "read_configs", "load_size_map"]
+from tdfo_tpu.utils.faults import FaultSpec
+
+__all__ = ["Config", "MeshSpec", "FaultSpec", "read_configs", "load_size_map"]
 
 
 @dataclass(frozen=True)
@@ -188,6 +194,36 @@ class Config:
     ps_min_shard_bytes: int = 0
     checkpoint_dir: str | None = None
     checkpoint_every_n_epochs: int = 10
+    # --- fault tolerance ---
+    # step-granular checkpoints: every N train steps the full state PLUS the
+    # data-stream cursor (epoch, batch offset) is saved, so a preempted run
+    # resumes from the exact batch instead of replaying the epoch
+    # (BackupAndRestore at step granularity, tensorflow2/train_ps.py:156).
+    # 0 = epoch-granular only (checkpoint_every_n_epochs still applies).
+    checkpoint_every_n_steps: int = 0
+    # corrupted-shard quarantine: a shard that fails to open/decode is
+    # skipped with a warning; the run fails only once MORE than this many
+    # shards are bad.  0 = any bad shard is fatal (the pre-quarantine
+    # behaviour).  Single-host semantics; on multi-host meshes a skipped
+    # shard must be skipped identically by every host (shared storage).
+    max_bad_shards: int = 0
+    # non-finite guard: after K CONSECUTIVE non-finite train losses the
+    # trainer restores the last good on-device state snapshot and skips the
+    # offending batch window (a `rollback` record lands in metrics.jsonl)
+    # instead of silently training on NaN optimizer state.  The guard
+    # fetches losses in windows of K steps (one host sync per window).
+    # 0 disables guard, snapshots, and syncs entirely.
+    nonfinite_tolerance: int = 3
+    # refresh the guard's on-device state snapshot every N steps (only at a
+    # window boundary whose losses were all finite, so the snapshot is
+    # known-good).  Copy cost is one HBM pass over the state — size this to
+    # taste on multi-GB-table runs.  Ignored when nonfinite_tolerance = 0.
+    snapshot_every_n_steps: int = 100
+    # deterministic fault injection ([faults] config table): kill_at_step /
+    # nan_at_step / fail_io_nth — see tdfo_tpu/utils/faults.py.  Test-only
+    # by design, but honoured by every real run so crash/resume tests run
+    # the exact production path.
+    faults: FaultSpec = field(default_factory=FaultSpec)
     log_every_n_steps: int = 100
     profile: bool = False
     # mirror every logged scalar into a TensorBoard events file next to the
@@ -249,6 +285,16 @@ class Config:
             raise ValueError(f"unknown sparse_optimizer: {self.sparse_optimizer!r}")
         if self.steps_per_execution < 1:
             raise ValueError("steps_per_execution must be >= 1")
+        if self.checkpoint_every_n_steps < 0:
+            raise ValueError(
+                "checkpoint_every_n_steps must be >= 0 (0 = epoch-granular)")
+        if self.max_bad_shards < 0:
+            raise ValueError("max_bad_shards must be >= 0 (0 = fail on any)")
+        if self.nonfinite_tolerance < 0:
+            raise ValueError(
+                "nonfinite_tolerance must be >= 0 (0 = guard disabled)")
+        if self.snapshot_every_n_steps < 1:
+            raise ValueError("snapshot_every_n_steps must be >= 1")
         if not self.streaming and self.write_format != "parquet":
             raise ValueError("streaming=false (map-style) requires parquet data")
 
@@ -281,6 +327,7 @@ def load_size_map(data_dir: Path) -> dict[str, int]:
 
 _CONFIG_FIELDS = {f.name for f in dataclasses.fields(Config)}
 _MESH_FIELDS = {f.name for f in dataclasses.fields(MeshSpec)} - {"axis_names"}
+_FAULT_FIELDS = {f.name for f in dataclasses.fields(FaultSpec)}
 
 
 def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
@@ -307,6 +354,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
             raise ValueError(f"unknown mesh config keys: {sorted(unknown_mesh)}")
         mesh = MeshSpec(**mesh_raw)
 
+    faults_raw = raw.pop("faults", {})
+    if isinstance(faults_raw, FaultSpec):
+        faults = faults_raw
+    else:
+        unknown_faults = set(faults_raw) - _FAULT_FIELDS
+        if unknown_faults:
+            raise ValueError(
+                f"unknown faults config keys: {sorted(unknown_faults)}")
+        faults = FaultSpec(**faults_raw)
+
     unknown = set(raw) - _CONFIG_FIELDS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -317,7 +374,7 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
         if key in raw:
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
-    cfg = Config(mesh=mesh, **raw)
+    cfg = Config(mesh=mesh, faults=faults, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

@@ -36,12 +36,34 @@ __all__ = [
     "make_mesh",
     "initialize_distributed",
     "spoof_cpu_devices",
+    "shard_map",
+    "axis_size",
     "data_sharding",
     "replicated_sharding",
     "DATA_AXIS",
     "MODEL_AXIS",
     "SEQ_AXIS",
 ]
+
+try:  # jax >= 0.5 exports shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:
+    # 0.4.x: same callable in the experimental namespace, with the
+    # replication check still spelled check_rep instead of check_vma
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map_exp(f, *args, **kwargs)
+
+try:  # jax >= 0.5
+    axis_size = jax.lax.axis_size
+except AttributeError:
+    def axis_size(axis_name):
+        # 0.4.x: jax.core.axis_frame returns the concrete size of a bound
+        # mesh axis — the same int lax.axis_size reports on newer jax
+        return jax.core.axis_frame(axis_name)
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
@@ -66,7 +88,12 @@ def spoof_cpu_devices(n: int = 8) -> None:
             flags + f" --xla_force_host_platform_device_count={n}"
         ).strip()
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices knob; the XLA_FLAGS hint set
+        # above covers it as long as jax has not initialised yet
+        pass
 
 
 def initialize_distributed(

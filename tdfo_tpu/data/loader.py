@@ -28,12 +28,19 @@ the arrow level.
 from __future__ import annotations
 
 import glob as _glob
+import zlib
 from pathlib import Path
 from typing import Iterator, Sequence
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
+
+from tdfo_tpu.utils.retry import retry_call
+
+# failure modes a corrupted/truncated shard presents as: quarantinable when
+# the stream was configured with max_bad_shards > 0
+_BAD_SHARD_ERRORS = (OSError, EOFError, zlib.error, pa.ArrowException)
 
 __all__ = [
     "ParquetStream",
@@ -129,10 +136,22 @@ class ParquetStream:
         columns: Sequence[str] | None = None,
         allow_ragged: bool = False,
         num_workers: int = 0,
+        max_bad_shards: int = 0,
     ):
         import jax
 
         self.files = list(files)
+        # corrupted-shard quarantine: files that failed to open/decode are
+        # skipped (0 rows) with a warning; the (max_bad_shards+1)-th bad
+        # shard is fatal.  0 keeps the historical any-failure-is-fatal
+        # behaviour.
+        self.max_bad_shards = int(max_bad_shards)
+        self._bad_files: dict[str, str] = {}
+        # resume support: _skip batches are fast-forwarded (decoded and
+        # discarded) by the next __iter__; _emitted tracks this epoch's
+        # position for state_dict().  One live iterator per stream.
+        self._skip = 0
+        self._emitted = 0
         self.allow_ragged = allow_ragged
         # >0: that many background threads read files ahead of the consumer
         # (order-preserving, so shuffles stay deterministic) — the
@@ -162,12 +181,47 @@ class ParquetStream:
     # ---- file-format hooks (overridden by TFRecordStream) ----
 
     def _file_row_count(self, path: str) -> int:
-        return pq.ParquetFile(path).metadata.num_rows
+        return retry_call(
+            lambda: pq.ParquetFile(path).metadata.num_rows,
+            description=f"parquet_metadata:{Path(path).name}",
+        )
 
     def _file_batches(self, path: str):
-        pf = pq.ParquetFile(path)
+        pf = retry_call(pq.ParquetFile, path,
+                        description=f"open_shard:{Path(path).name}")
         for rb in pf.iter_batches(batch_size=65536, columns=self.columns):
             yield _to_numpy_columns(rb, allow_ragged=self.allow_ragged)
+
+    # ---- corrupted-shard quarantine ----
+
+    def _quarantine(self, path: str, err: BaseException) -> None:
+        """Record ``path`` as bad (skip + warn).  Raises once MORE than
+        ``max_bad_shards`` distinct shards have failed — a data set that
+        rotten is a pipeline bug, not a shard to shrug off."""
+        if path not in self._bad_files:
+            self._bad_files[path] = f"{type(err).__name__}: {err}"
+            print(f"[loader] quarantined bad shard {path}: "
+                  f"{self._bad_files[path]} "
+                  f"({len(self._bad_files)}/{self.max_bad_shards} allowed)",
+                  flush=True)
+        if len(self._bad_files) > self.max_bad_shards:
+            raise RuntimeError(
+                f"{len(self._bad_files)} corrupted shard(s), more than "
+                f"max_bad_shards={self.max_bad_shards} allows: "
+                f"{self._bad_files}"
+            ) from err
+
+    def _row_count_safe(self, path: str) -> int:
+        """Row count with quarantine: a shard whose footer/sidecar cannot be
+        read counts 0 rows and is excluded from iteration — deterministic
+        across hosts because EVERY host scans every footer for the budget."""
+        if path in self._bad_files:
+            return 0
+        try:
+            return self._file_row_count(path)
+        except _BAD_SHARD_ERRORS as e:
+            self._quarantine(path, e)
+            return 0
 
     def _files_batches(self, files: Sequence[str]):
         """All batches across ``files`` in order; with ``num_workers`` > 0 a
@@ -176,9 +230,19 @@ class ParquetStream:
         ahead of the consumer.  Order is preserved — determinism is part of
         the loader's contract — and host memory stays O(num_workers x a few
         arrow batches)."""
+        files = [f for f in files if f not in self._bad_files]
         if self.num_workers <= 0:
             for f in files:
-                yield from self._file_batches(f)
+                try:
+                    yield from self._file_batches(f)
+                except _BAD_SHARD_ERRORS as e:
+                    # mid-read corruption: rows already emitted from this
+                    # shard stay emitted; the remainder is quarantined.  On
+                    # multi-host meshes this can shrink one host's row count
+                    # below the footer-derived budget — shared-storage
+                    # corruption is visible to every host, but keep
+                    # max_bad_shards=0 on pods unless shards replicate.
+                    self._quarantine(f, e)
             return
         import collections
         import queue as _queue
@@ -222,22 +286,25 @@ class ParquetStream:
                 f = next(it, None)
                 if f is None:
                     break
-                pending.append(start_reader(f))
+                pending.append((f, start_reader(f)))
             while pending:
-                q = pending.popleft()
+                path, q = pending.popleft()
                 while True:
                     item = q.get()
                     if item is _END:
                         break
                     if isinstance(item, BaseException):
+                        if isinstance(item, _BAD_SHARD_ERRORS):
+                            self._quarantine(path, item)  # skip the rest
+                            break
                         raise item
                     yield item
                 f = next(it, None)
                 if f is not None:
-                    pending.append(start_reader(f))
+                    pending.append((f, start_reader(f)))
         finally:
             stop.set()
-            for q in pending:  # unblock any waiting worker
+            for _, q in pending:  # unblock any waiting worker
                 while not q.empty():
                     try:
                         q.get_nowait()
@@ -256,7 +323,7 @@ class ParquetStream:
         if self._shard_by_file:
             rows = [
                 sum(
-                    self._file_row_count(f)
+                    self._row_count_safe(f)
                     for f in self.files[r :: self.process_count]
                 )
                 for r in range(self.process_count)
@@ -265,14 +332,43 @@ class ParquetStream:
         else:
             # strided: rank r owns global rows g with g % P == r_assigned;
             # the smallest share is floor(N / P).
-            n = sum(self._file_row_count(f) for f in self.files)
+            n = sum(self._row_count_safe(f) for f in self.files)
             min_rows = n // self.process_count
         return min_rows // self.batch_size
 
     def set_epoch(self, epoch: int) -> None:
         """Reshuffle order for a new epoch (HF ``set_epoch`` parity,
-        ``jax-flax/train.py:143``)."""
+        ``jax-flax/train.py:143``).  Clears any pending resume fast-forward —
+        call :meth:`load_state_dict` AFTER set_epoch to resume mid-epoch."""
         self._epoch = int(epoch)
+        self._skip = 0
+
+    # ---- step-granular resume (checkpoint cursor contract) ----
+
+    def state_dict(self) -> dict[str, int]:
+        """Position cursor: (seed, epoch, batches emitted this epoch).  The
+        epoch's batch sequence is a pure function of (seed, epoch) — file
+        permutation, block shuffle and batch assembly all derive from
+        ``default_rng((seed, epoch))`` — so the cursor pins the exact batch.
+
+        NOTE: counts batches handed to the CALLER of ``__iter__``.  Behind a
+        prefetcher, count consumed batches yourself (the Trainer does) and
+        build the cursor from that."""
+        return {"seed": int(self.seed), "epoch": int(self._epoch),
+                "batches_emitted": int(self._emitted)}
+
+    def load_state_dict(self, state: dict[str, int]) -> None:
+        """Resume: the next ``__iter__`` fast-forwards ``batches_emitted``
+        batches (decode-and-discard — the shuffle pool must replay to
+        reproduce the stream bit-exactly) and yields from there."""
+        if int(state.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"stream cursor was recorded with seed "
+                f"{state['seed']}, this stream uses {self.seed} — resuming "
+                "would yield a different batch sequence"
+            )
+        self._epoch = int(state["epoch"])
+        self._skip = int(state["batches_emitted"])
 
     def max_batches_per_host(self) -> int:
         """The LARGEST per-host batch count this epoch (ceil division, no
@@ -284,11 +380,11 @@ class ParquetStream:
         for r in range(max(self.process_count, 1)):
             if self._shard_by_file:
                 rows = sum(
-                    self._file_row_count(f)
+                    self._row_count_safe(f)
                     for f in self.files[r :: self.process_count]
                 )
             else:
-                n = sum(self._file_row_count(f) for f in self.files)
+                n = sum(self._row_count_safe(f) for f in self.files)
                 p = max(self.process_count, 1)
                 rows = (n - r + p - 1) // p
             counts.append(-(-rows // self.batch_size))
@@ -296,11 +392,16 @@ class ParquetStream:
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
         budget = self._batches_per_host() if self.drop_last else None
-        emitted = 0
+        skip, self._skip = self._skip, 0
+        pos = 0
+        self._emitted = 0
         for batch in self._iter_unbounded():
-            if budget is not None and emitted >= budget:
+            if budget is not None and pos >= budget:
                 return
-            emitted += 1
+            pos += 1
+            self._emitted = pos
+            if pos <= skip:
+                continue  # resume fast-forward: already consumed pre-crash
             yield batch
 
     def _iter_unbounded(self) -> Iterator[dict[str, np.ndarray]]:
@@ -393,8 +494,11 @@ class TFRecordStream(ParquetStream):
                 # no per-shard sidecar: count by scanning once, then CACHE
                 # the count to a sidecar so later epochs (and other runs /
                 # hosts) never rescan the whole gzip stream again
-                self._row_counts[path] = sum(
-                    1 for _ in read_tfrecord_records(path, self.compression)
+                self._row_counts[path] = retry_call(
+                    lambda: sum(
+                        1 for _ in read_tfrecord_records(path, self.compression)
+                    ),
+                    description=f"scan_tfrecord:{p.name}",
                 )
                 from tdfo_tpu.data.tfrecord import write_shard_sizes_entry
 
@@ -478,10 +582,29 @@ class MapStream:
         self.seed = seed
         self.drop_last = drop_last
         self._epoch = 0
+        self._skip = 0
+        self._emitted = 0
         self._n = len(next(iter(self.table.values())))
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = int(epoch)
+        self._skip = 0
+
+    def state_dict(self) -> dict[str, int]:
+        """Same cursor contract as :meth:`ParquetStream.state_dict`."""
+        return {"seed": int(self.seed), "epoch": int(self._epoch),
+                "batches_emitted": int(self._emitted)}
+
+    def load_state_dict(self, state: dict[str, int]) -> None:
+        """Resume mid-epoch; map-style skip is O(1) (index arithmetic into
+        the epoch permutation), no replay needed."""
+        if int(state.get("seed", self.seed)) != self.seed:
+            raise ValueError(
+                f"stream cursor was recorded with seed "
+                f"{state['seed']}, this stream uses {self.seed}"
+            )
+        self._epoch = int(state["epoch"])
+        self._skip = int(state["batches_emitted"])
 
     def max_batches_per_host(self) -> int:
         # must mirror the __iter__ count exactly: drop_last floors, else ceils
@@ -490,10 +613,15 @@ class MapStream:
         return -(-self._n // self.batch_size)
 
     def __iter__(self) -> Iterator[dict[str, np.ndarray]]:
-        yield from permutation_batches(
-            self.table, self.batch_size, shuffle=self.shuffle, seed=self.seed,
-            epoch=self._epoch, drop_last=self.drop_last,
-        )
+        skip, self._skip = self._skip, 0
+        self._emitted = skip
+        idx = np.arange(self._n)
+        if self.shuffle:
+            np.random.default_rng((self.seed, self._epoch)).shuffle(idx)
+        end = self._n - self._n % self.batch_size if self.drop_last else self._n
+        for i in range(skip * self.batch_size, end, self.batch_size):
+            self._emitted += 1
+            yield _take(self.table, idx[i : i + self.batch_size])
 
 
 def prefetch_to_mesh(it, mesh, pspec=None, *, size: int = 2):

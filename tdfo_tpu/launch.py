@@ -102,6 +102,11 @@ def main(argv: list[str] | None = None) -> int:
         alt = Path(cfg.data_dir) / "size_map_bert4rec.json"
         if alt.exists():
             cfg = cfg.replace(size_map=json.loads(alt.read_text()))
+    if cfg.faults.any():
+        # a [faults] section deliberately kills/corrupts this run (test
+        # harness, tdfo_tpu/utils/faults.py) — make that impossible to miss
+        # in the launch log of a run that mysteriously dies with exit 17
+        print(f"WARNING: fault injection armed: {cfg.faults}", flush=True)
     from tdfo_tpu.train.trainer import Trainer
 
     metrics = Trainer(cfg, log_dir=args.log_dir).fit()

@@ -44,6 +44,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 ships the same dataclass under the TPU-prefixed name
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 __all__ = [
     "flash_attention",
     "LineLayout",
@@ -917,7 +920,7 @@ def fat_line_update(
         out_shape=jax.ShapeDtypeStruct(fat.shape, fat.dtype),
         # fat (operands: ids, corr, gp, [tl,] fat)
         input_output_aliases={3 if row_form else 4: 0},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
@@ -1136,7 +1139,7 @@ def fat_line_update_routed(
         out_shape=jax.ShapeDtypeStruct(fat.shape, fat.dtype),
         # operands: ulines, sdiv, corr, tsi, lines, g_u, fat
         input_output_aliases={6: 0},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,

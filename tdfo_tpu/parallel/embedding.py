@@ -48,7 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tdfo_tpu.core.mesh import MODEL_AXIS
+from tdfo_tpu.core.mesh import MODEL_AXIS, shard_map
 
 __all__ = ["EmbeddingSpec", "ShardedEmbeddingCollection", "make_embedding_specs"]
 
@@ -475,7 +475,7 @@ class ShardedEmbeddingCollection:
         mesh = self.mesh
         fat_spec = P(axis, None, None)
         slots_spec = tuple(P() for _ in slots)
-        new_table, new_slots = jax.shard_map(
+        new_table, new_slots = shard_map(
             local,
             mesh=mesh,
             in_specs=(fat_spec, slots_spec, P(DATA_AXIS), P(DATA_AXIS, None)),
@@ -522,7 +522,7 @@ class ShardedEmbeddingCollection:
                 dropped = jnp.sum(jnp.maximum(counts - cap, 0))
                 return jax.lax.psum(dropped.astype(jnp.int32), axis)
 
-            cnt = jax.shard_map(
+            cnt = shard_map(
                 local, mesh=self.mesh,
                 in_specs=P(axis, *([None] * (ids.ndim - 1))), out_specs=P(),
                 check_vma=False,
@@ -624,7 +624,7 @@ class ShardedEmbeddingCollection:
         ids_spec = P(DATA_AXIS, *([None] * (ids.ndim - 1)))
         out_spec = P(DATA_AXIS, *([None] * ids.ndim))
         table_spec = P(axis, *([None] * (table.ndim - 1)))
-        return jax.shard_map(
+        return shard_map(
             local,
             mesh=mesh,
             in_specs=(table_spec, ids_spec),
@@ -708,7 +708,7 @@ class ShardedEmbeddingCollection:
             )
 
         table_spec = P(axis, *([None] * (table.ndim - 1)))
-        return jax.shard_map(
+        return shard_map(
             local,
             mesh=mesh,
             in_specs=(table_spec, P(axis)),

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from tdfo_tpu.core.mesh import SEQ_AXIS
+from tdfo_tpu.core.mesh import SEQ_AXIS, axis_size, shard_map
 
 __all__ = ["ring_attention", "ring_flash_attention", "ring_self_attention", "make_ring_attn_fn"]
 
@@ -85,7 +85,7 @@ def ring_attention(
     all-XLA counterpart of the Pallas flash kernel, composed with the ring.
     Must divide the local Tk; identical numerics either way.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     b, h, tq, dh = q.shape
     tk = k.shape[2]
     scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
@@ -151,7 +151,7 @@ def _ring_flash_fwd_impl(q, k, v, key_valid, axis_name, block_q, block_k,
                          interpret):
     from tdfo_tpu.ops.pallas_kernels import _flash_fwd_impl
 
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]
     b, h, tq, dh = q.shape
 
@@ -224,7 +224,7 @@ def _ring_flash_bwd(axis_name, block_q, block_k, interpret, res, g):
     from tdfo_tpu.ops.pallas_kernels import _flash_bwd_impl
 
     q, k, v, key_valid, out, lse = res
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]
     b, h, tq, _ = q.shape
     lse8 = jnp.broadcast_to(lse[:, :, None, :], (b, h, 8, tq))
@@ -310,7 +310,7 @@ def ring_self_attention(
         raise ValueError(f"unknown ring impl {impl!r}")
     if key_valid is None:
         key_valid = jnp.ones((q.shape[0], t), bool)
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, valid_spec),

@@ -16,12 +16,15 @@ family and parallelism regime — the BackupAndRestore capability, generalised.
 
 from __future__ import annotations
 
+import json
 from pathlib import Path
 from typing import Any
 
 import jax
 import numpy as np
 import orbax.checkpoint as ocp
+
+from tdfo_tpu.utils.retry import retry_call
 
 __all__ = ["CheckpointManager", "LAYOUT_VERSION"]
 
@@ -40,13 +43,16 @@ LAYOUT_VERSION = 3
 
 
 class CheckpointManager:
-    """Epoch-indexed save/restore of an arbitrary train-state pytree.
+    """Step-indexed save/restore of an arbitrary train-state pytree.
 
-    ``save(step_id, state)`` / ``restore(state_like)`` -> (step_id, state) or
-    None.  ``state_like`` provides structure, shardings, and dtypes (use the
-    freshly initialised state); restored arrays land with the same shardings.
-    Static leaves (``apply_fn``, ``tx``...) registered as dataclass static
-    fields are not serialised — they come from ``state_like``.
+    ``save(step_id, state, cursor=...)`` / ``restore(state_like)`` ->
+    (step_id, state, cursor) or None.  ``step_id`` is whatever monotone id
+    the caller uses (the Trainer uses the run-global data step, so mid-epoch
+    checkpoints and epoch-end checkpoints share one ordered namespace).
+    ``state_like`` provides structure, shardings, and dtypes (use the freshly
+    initialised state); restored arrays land with the same shardings.  Static
+    leaves (``apply_fn``, ``tx``...) registered as dataclass static fields
+    are not serialised — they come from ``state_like``.
     """
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3):
@@ -59,34 +65,91 @@ class CheckpointManager:
             ),
         )
 
-    def save(self, step_id: int, state: Any, *, force: bool = False) -> None:
+    def save(
+        self,
+        step_id: int,
+        state: Any,
+        *,
+        cursor: dict[str, Any] | None = None,
+        force: bool = False,
+    ) -> None:
+        """Write the state pytree (and an optional data-stream ``cursor``)
+        under ``step_id``.  The cursor — epoch, batches consumed, shuffle-seed
+        provenance — is a small JSON sidecar (``cursor_<step_id>.json``)
+        written by process 0 only, AFTER the orbax write is durable, so a
+        cursor file on disk always refers to a complete checkpoint.  Saves
+        retry with backoff (``tdfo_tpu/utils/retry.py``): transient storage
+        failures must not kill an otherwise-healthy run."""
         payload = {
             "layout_version": np.asarray(LAYOUT_VERSION, np.int32),
             "state": state,
         }
-        self._mgr.save(step_id, args=ocp.args.StandardSave(payload), force=force)
+        retry_call(
+            self._mgr.save,
+            step_id,
+            args=ocp.args.StandardSave(payload),
+            force=force,
+            description=f"ckpt_save:{step_id}",
+        )
         self._mgr.wait_until_finished()
+        if jax.process_index() == 0:
+            cpath = self._cursor_path(step_id)
+            if cursor is not None:
+                retry_call(
+                    cpath.write_text,
+                    json.dumps(cursor),
+                    description=f"cursor_save:{step_id}",
+                )
+            elif cpath.exists():
+                cpath.unlink()  # force-overwrite must not keep a stale cursor
+            self._prune_cursors()
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def _cursor_path(self, step_id: int) -> Path:
+        return self._dir / f"cursor_{step_id}.json"
+
+    def _prune_cursors(self) -> None:
+        """Drop cursor sidecars whose checkpoint was garbage-collected by
+        ``max_to_keep`` so the directory never accumulates orphans."""
+        live = set(self._mgr.all_steps())
+        for p in self._dir.glob("cursor_*.json"):
+            try:
+                step = int(p.stem.split("_", 1)[1])
+            except ValueError:
+                continue
+            if step not in live:
+                p.unlink(missing_ok=True)
+
+    def read_cursor(self, step_id: int) -> dict[str, Any] | None:
+        """The data-stream cursor saved with ``step_id``, or None when absent
+        (legacy epoch-indexed checkpoints have no cursor)."""
+        cpath = self._cursor_path(step_id)
+        if not cpath.exists():
+            return None
+        return json.loads(cpath.read_text())
+
     def restore(self, state_like: Any, step_id: int | None = None):
         """Restore into the structure/shardings of ``state_like``.  Returns
-        ``(step_id, state)`` or ``None`` when no checkpoint exists.  Refuses
-        checkpoints whose storage-layout version differs from
-        :data:`LAYOUT_VERSION` (same shapes, different value layout — a
-        silent-corruption hazard, e.g. the round-4 fused-QKV reorder or the
-        round-5 fat-line packing)."""
+        ``(step_id, state, cursor)`` or ``None`` when no checkpoint exists;
+        ``cursor`` is the data-stream position saved alongside (None for
+        legacy epoch-indexed checkpoints).  Refuses checkpoints whose
+        storage-layout version differs from :data:`LAYOUT_VERSION` (same
+        shapes, different value layout — a silent-corruption hazard, e.g. the
+        round-4 fused-QKV reorder or the round-5 fat-line packing)."""
         step_id = self._mgr.latest_step() if step_id is None else step_id
         if step_id is None:
             return None
         # probe the SAVED tree's metadata for the stamp before restoring:
         # a missing stamp is the legacy (pre-versioning) format and must be
         # refused — without conflating genuine I/O or sharding errors from
-        # the restore itself with layout incompatibility
+        # the restore itself with layout incompatibility.  Only the probe's
+        # expected failure modes are swallowed (absent/partial metadata,
+        # schema drift across orbax versions); anything else propagates.
         try:
             meta = self._mgr.item_metadata(step_id)
-        except Exception:  # noqa: BLE001 — metadata probe is best-effort
+        except (OSError, ValueError, KeyError, TypeError):
             meta = None
         meta_tree = getattr(meta, "tree", meta)
         if meta_tree is not None and "layout_version" not in meta_tree:
@@ -102,8 +165,11 @@ class CheckpointManager:
             "layout_version": jax.ShapeDtypeStruct((), np.int32),
             "state": jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like),
         }
-        restored = self._mgr.restore(
-            step_id, args=ocp.args.StandardRestore(abstract)
+        restored = retry_call(
+            self._mgr.restore,
+            step_id,
+            args=ocp.args.StandardRestore(abstract),
+            description=f"ckpt_restore:{step_id}",
         )
         found = int(np.asarray(restored["layout_version"]))
         if found != LAYOUT_VERSION:
@@ -115,7 +181,11 @@ class CheckpointManager:
                 "resuming would silently scramble parameters, so it is "
                 "refused.  Retrain, or convert the checkpoint offline."
             )
-        return step_id, _merge_static(state_like, restored["state"])
+        return (
+            step_id,
+            _merge_static(state_like, restored["state"]),
+            self.read_cursor(step_id),
+        )
 
     def close(self) -> None:
         self._mgr.close()

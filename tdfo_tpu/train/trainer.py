@@ -16,14 +16,22 @@ Unifies the reference's five ``main()`` loops (``jax-flax/train.py:95-164``,
     the reference lacks, SURVEY.md §5.5), optional ``jax.profiler`` traces
     (§5.1).
 
-Failure detection: training survives preemption by construction — restart the
+Fault tolerance: training survives preemption by construction — restart the
 same command and the driver resumes from the newest checkpoint (the
-``BackupAndRestore`` capability, ``tensorflow2/train_ps.py:156``).
+``BackupAndRestore`` capability, ``tensorflow2/train_ps.py:156``), now at
+STEP granularity: ``checkpoint_every_n_steps`` saves mid-epoch with a
+data-stream cursor, and resume fast-forwards the stream to the exact batch.
+A non-finite-loss guard keeps a bounded on-device snapshot and rolls back to
+it (skipping the offending batch window) instead of training through NaNs;
+checkpoint I/O retries with backoff (``tdfo_tpu/utils/retry.py``); the
+``[faults]`` config section injects deterministic kills/NaNs/I/O failures so
+all of this is testable (``tdfo_tpu/utils/faults.py``).
 """
 
 from __future__ import annotations
 
 import json
+import math
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -46,6 +54,8 @@ from tdfo_tpu.data.loader import (
 from tdfo_tpu.train.metrics import AUC, recalls_and_ndcgs_for_ks
 from tdfo_tpu.train.state import TrainState, make_adamw
 from tdfo_tpu.train.step import make_eval_step, make_multi_step, make_train_step
+from tdfo_tpu.utils import faults as _faults
+from tdfo_tpu.utils import retry as _retry
 
 __all__ = ["Trainer", "MetricLogger", "pad_batch"]
 
@@ -97,10 +107,14 @@ class MetricLogger:
             self._n += 1
 
     def close(self) -> None:
+        """Idempotent: ``fit`` closes in a ``finally`` block, and a caller
+        logging afterwards falls back to stdout-only instead of crashing."""
         if self._f is not None:
             self._f.close()
+            self._f = None
         if self._tb is not None:
             self._tb.close()
+            self._tb = None
 
 
 def pad_batch(batch: dict[str, np.ndarray], size: int) -> tuple[dict[str, np.ndarray], np.ndarray]:
@@ -244,6 +258,16 @@ def _commit_replicated(state, mesh):
     return jax.tree.map(commit, state)
 
 
+def _copy_tree(tree):
+    """Deep-copy the array leaves of a pytree into FRESH device buffers
+    (shardings preserved — the copy is an eager op and computation follows
+    data).  Needed wherever a tree must survive donation: the dense train
+    step donates its state, so a rollback snapshot aliasing live buffers
+    would be invalidated by the very next step."""
+    return jax.tree.map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
+
+
 class Trainer:
     """Config-driven trainer for both workload families."""
 
@@ -259,9 +283,19 @@ class Trainer:
         self.logger = MetricLogger(log_dir or config.checkpoint_dir,
                                    tensorboard=config.tensorboard)
         self._ckpt = None
-        self._logged_steps = 0  # run-global step counter for TB x-axes
+        self._logged_steps = 0  # run-global data-step counter (batches consumed)
         self._a2a_overflow = None  # alltoall dropped-id diagnostic (jitted)
         self._map_streams: dict = {}  # streaming=false table cache
+        # retryable-I/O observability: failed attempts land next to
+        # metrics.jsonl (process 0 only; set_failure_log is a no-op path-wise
+        # on other processes because MetricLogger made the dir on p0)
+        out_dir = log_dir or config.checkpoint_dir
+        if out_dir and jax.process_index() == 0:
+            _retry.set_failure_log(Path(out_dir) / "retries.jsonl")
+        # arm (or clear) the process-global deterministic fault injector from
+        # THIS config — the kill marker lives in checkpoint_dir so "restart
+        # the same command" converges instead of crash-looping
+        _faults.configure(config.faults, config.checkpoint_dir or None)
         if config.checkpoint_dir:
             from tdfo_tpu.train.checkpoint import CheckpointManager
 
@@ -642,19 +676,30 @@ class Trainer:
             # lists); only the jagged TRAIN stream opts into object columns
             allow_ragged=train and cfg.model == "bert4rec" and cfg.jagged,
             num_workers=cfg.num_workers,
+            max_bad_shards=cfg.max_bad_shards,
         )
 
-    def _train_batches(self, epoch: int) -> Iterator[tuple[dict, int]]:
+    def _train_batches(self, epoch: int, skip: int = 0) -> Iterator[tuple[dict, int]]:
         """Yields ``(device_batch, n_steps_in_batch)``.
 
         With ``steps_per_execution > 1`` host batches are stacked into
         [K, B, ...] chunks and the whole chunk ships as one transfer feeding
         one compiled multi-step dispatch; a short tail chunk recompiles at
         most once per distinct K.
+
+        ``skip`` resumes mid-epoch: the stream fast-forwards that many host
+        batches (the checkpoint cursor's step count) before yielding, so the
+        post-resume batch sequence is bit-identical to the uninterrupted
+        epoch's tail.  With spe>1 the chunk BOUNDARIES shift relative to the
+        uninterrupted run, but a chunk is a ``lax.scan`` of the same single
+        step over the same ordered batches — state evolution is unchanged.
         """
         cfg = self.config
         stream = self._stream(self._train_pattern, train=True)
         stream.set_epoch(epoch)
+        if skip:
+            stream.load_state_dict({"seed": cfg.seed, "epoch": epoch,
+                                    "batches_emitted": skip})
         if cfg.model == "bert4rec" and cfg.jagged:
             from tdfo_tpu.data.jagged import pack_rows
 
@@ -677,6 +722,20 @@ class Trainer:
             )
         else:
             renamed = iter(stream)
+        inj = _faults.active()
+        if inj is not None and inj.spec.nan_at_step:
+            # deterministic NaN injection keyed on run-global data position
+            # (stable across resume and steps_per_execution regrouping);
+            # _logged_steps still holds the epoch-start value here — the
+            # epoch-end += happens after this generator is exhausted
+            base, poison = self._logged_steps, inj.poison_batch
+
+            def poisoned(gen, pos):
+                for b in gen:
+                    pos += 1
+                    yield poison(b, base + pos)
+
+            renamed = poisoned(renamed, skip)
         spe = cfg.steps_per_execution
         if spe <= 1:
             for batch in prefetch_to_mesh(renamed, self.mesh, P("data")):
@@ -705,68 +764,172 @@ class Trainer:
             return jax.disable_jit()
         return contextlib.nullcontext()
 
-    def train_epoch(self, epoch: int) -> float:
+    def train_epoch(self, epoch: int, *, start_step: int = 0,
+                    loss_sum: float = 0.0, contributed: int = 0) -> float:
         with self._jit_ctx():
-            return self._train_epoch(epoch)
+            return self._train_epoch(epoch, start_step=start_step,
+                                     loss_sum=loss_sum, contributed=contributed)
 
-    def _train_epoch(self, epoch: int) -> float:
+    def _train_epoch(self, epoch: int, *, start_step: int = 0,
+                     loss_sum: float = 0.0, contributed: int = 0) -> float:
+        """One training epoch, resumable at step granularity.
+
+        ``start_step`` (plus the matching partial ``loss_sum``/``contributed``
+        from the checkpoint cursor) restarts the epoch at an exact batch; the
+        stream fast-forwards, so the tail is bit-identical to an
+        uninterrupted epoch.  Device losses queue in a pending window and are
+        fetched together at log/checkpoint boundaries — the same sync cadence
+        as before (a per-step ``float()`` would serialise dispatch and defeat
+        the double-buffered prefetch), so the non-finite guard below adds NO
+        extra host round-trips.
+
+        Non-finite guard: with ``nonfinite_tolerance`` = K > 0, a known-good
+        (state, train-AUC, loss-sums) snapshot is kept ON DEVICE — refreshed
+        every ``snapshot_every_n_steps`` once the window since the last
+        snapshot verified finite — and K consecutive non-finite batch losses
+        roll back to it, SKIPPING the offending batch window (data position
+        stays monotone; ``state.step`` rewinds).  Each rollback emits a
+        ``rollback`` record to metrics.jsonl.  The snapshot costs one extra
+        state copy in device memory; set ``nonfinite_tolerance = 0`` to
+        disable the guard (and the copy) on memory-tight runs.
+        """
         cfg = self.config
+        inj = _faults.active()
         t0 = time.perf_counter()
-        # loss accumulates ON DEVICE; the only host syncs are at log
-        # boundaries and epoch end (a per-step float() would serialise
-        # dispatch and defeat the double-buffered prefetch).
-        loss_sum = None
-        n_steps = 0
-        next_log = cfg.log_every_n_steps
+        n_steps = start_step
+        next_log = start_step + cfg.log_every_n_steps
         profiled = cfg.profile and epoch == 0 and jax.process_index() == 0
         # train-side streaming AUC on this epoch's predictions, folded ON
         # DEVICE from the step's aux logits — no second forward pass
-        # (jax-flax/train_dp.py:190,219-220 parity)
+        # (jax-flax/train_dp.py:190,219-220 parity).  Not persisted in the
+        # cursor (device histograms): after a mid-epoch resume the epoch AUC
+        # covers post-resume steps only.  State evolution is unaffected.
         train_auc = AUC.empty() if self._train_auc_enabled else None
-        for batch, k in self._train_batches(epoch):
-            if profiled is True and n_steps >= 10:
-                jax.profiler.start_trace(str(Path(cfg.checkpoint_dir or ".") / "profile"))
-                profiled = "tracing"
-            if cfg.model == "bert4rec":
-                self.state, loss = self.train_step(self.state, batch, self._dropout_rng)
-            else:
-                self.state, loss, train_auc = self.train_step(
-                    self.state, batch, train_auc
+        tol = cfg.nonfinite_tolerance
+        guard = tol > 0
+        # pending: (device loss, steps in batch, global data step)
+        pending: list[tuple[jax.Array, int, int]] = []
+        pending_steps = 0
+        flush_every = max(1, cfg.log_every_n_steps)
+        consec_bad = 0
+        snap = None  # (state, auc, loss_sum, contributed, global data step)
+        steps_at_snap = n_steps
+        if guard:
+            snap = (_copy_tree(self.state), _copy_tree(train_auc),
+                    loss_sum, contributed, self._logged_steps + n_steps)
+
+        def flush_checks() -> None:
+            """Fetch queued losses: fold finite ones into the epoch sums,
+            roll back on ``tol`` consecutive non-finite steps, refresh the
+            snapshot after a clean window."""
+            nonlocal loss_sum, contributed, consec_bad, snap, train_auc
+            nonlocal steps_at_snap, pending_steps
+            rolled = False
+            for loss_dev, k, gstep in pending:
+                v = float(loss_dev)
+                if math.isfinite(v):
+                    consec_bad = 0
+                    loss_sum += v * k
+                    contributed += k
+                    continue
+                consec_bad += k  # non-finite losses never fold into the sums
+                if not guard or consec_bad < tol:
+                    continue
+                # bounded rollback: restore the last known-good snapshot
+                # (device copy, no disk) and keep consuming data FORWARD —
+                # the poisoned window is skipped, not retried
+                state_c, auc_c, ls, ct, sg = snap
+                self.state = _copy_tree(state_c)  # snapshot must survive donation
+                train_auc = _copy_tree(auc_c)
+                loss_sum, contributed = ls, ct
+                consec_bad = 0
+                rolled = True
+                self.logger.log(
+                    epoch=epoch, rollback=1, global_step=gstep,
+                    restored_to_step=sg, skipped_steps=gstep - sg,
+                    nonfinite_loss=v,
                 )
-            n_steps += k
-            loss_k = loss * k  # chunk mean -> chunk sum (k=1: identity)
-            loss_sum = loss_k if loss_sum is None else loss_sum + loss_k
-            if profiled == "tracing" and n_steps >= 20:
-                jax.block_until_ready(loss)
+                break  # later pending losses came from the poisoned lineage
+            pending.clear()
+            pending_steps = 0
+            if (guard and not rolled and consec_bad == 0
+                    and n_steps - steps_at_snap >= cfg.snapshot_every_n_steps):
+                snap = (_copy_tree(self.state), _copy_tree(train_auc),
+                        loss_sum, contributed, self._logged_steps + n_steps)
+                steps_at_snap = n_steps
+
+        ckpt_n = cfg.checkpoint_every_n_steps if self._ckpt is not None else 0
+        next_ckpt = (n_steps // ckpt_n + 1) * ckpt_n if ckpt_n else None
+        loss = None
+        try:
+            for batch, k in self._train_batches(epoch, skip=start_step):
+                if profiled is True and n_steps >= 10:
+                    jax.profiler.start_trace(str(Path(cfg.checkpoint_dir or ".") / "profile"))
+                    profiled = "tracing"
+                if cfg.model == "bert4rec":
+                    self.state, loss = self.train_step(self.state, batch, self._dropout_rng)
+                else:
+                    self.state, loss, train_auc = self.train_step(
+                        self.state, batch, train_auc
+                    )
+                n_steps += k
+                gstep = self._logged_steps + n_steps
+                pending.append((loss, k, gstep))
+                pending_steps += k
+                if pending_steps >= flush_every:
+                    flush_checks()
+                if profiled == "tracing" and n_steps >= 20:
+                    jax.block_until_ready(loss)
+                    jax.profiler.stop_trace()
+                    profiled = False
+                if next_ckpt is not None and n_steps >= next_ckpt:
+                    # never persist an unverified window: flushing first means
+                    # a detected-NaN state rolls back BEFORE the save; force
+                    # overwrites a step id a prior (crashed) run already wrote
+                    flush_checks()
+                    self._ckpt.save(
+                        gstep, self.state, force=True,
+                        cursor={"epoch": epoch, "step": n_steps,
+                                "epoch_complete": False, "global_step": gstep,
+                                "loss_sum": loss_sum,
+                                "contributed": contributed},
+                    )
+                    next_ckpt = (n_steps // ckpt_n + 1) * ckpt_n
+                if inj is not None:
+                    inj.maybe_kill(gstep)  # after the save: ckpt is durable
+                if n_steps >= next_log:
+                    rec = dict(epoch=epoch, step=n_steps, train_loss=float(loss))
+                    if self._a2a_overflow is not None:
+                        # ids dropped by the finite a2a capacity THIS batch
+                        # (zero vectors under skew — watch for quality decay)
+                        rec["a2a_overflow_ids"] = int(
+                            self._a2a_overflow(self.state, batch))
+                    # TB charts need a run-global x (per-epoch `step` resets,
+                    # which would fold multi-epoch curves back on themselves)
+                    rec["global_step"] = gstep
+                    self.logger.log(**rec)
+                    # chunked counting can jump n_steps past several
+                    # intervals; advance past n_steps so each interval logs
+                    # at most once
+                    next_log = n_steps + cfg.log_every_n_steps
+        finally:
+            if profiled == "tracing":
+                # epoch ended (or raised) inside the trace window: close the
+                # trace so the next epoch/run can profile again
+                if loss is not None:
+                    jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
-                profiled = False
-            if n_steps >= next_log:
-                rec = dict(epoch=epoch, step=n_steps, train_loss=float(loss))
-                if self._a2a_overflow is not None:
-                    # ids dropped by the finite a2a capacity THIS batch
-                    # (zero vectors under skew — watch for quality decay)
-                    rec["a2a_overflow_ids"] = int(
-                        self._a2a_overflow(self.state, batch))
-                # TB charts need a run-global x (per-epoch `step` resets,
-                # which would fold multi-epoch curves back on themselves)
-                rec["global_step"] = self._logged_steps + n_steps
-                self.logger.log(**rec)
-                # chunked counting can jump n_steps past several intervals;
-                # advance past n_steps so each interval logs at most once
-                next_log = n_steps + cfg.log_every_n_steps
-        if profiled == "tracing":
-            # epoch ended inside the trace window: close it cleanly
-            jax.block_until_ready(loss_sum)
-            jax.profiler.stop_trace()
+        flush_checks()
         dt = time.perf_counter() - t0
+        ran = n_steps - start_step  # steps actually executed THIS session
         self._logged_steps += n_steps
-        avg = float(loss_sum) / n_steps if n_steps else 0.0
+        avg = loss_sum / contributed if contributed else 0.0
         extra: dict[str, float] = {}
         if train_auc is not None and n_steps:
             extra["train_auc"] = float(train_auc.result())
         self.logger.log(
             epoch=epoch, train_loss_epoch=avg, steps=n_steps,
-            examples_per_sec=n_steps * cfg.per_device_train_batch_size
+            examples_per_sec=ran * cfg.per_device_train_batch_size
             * self.mesh.shape["data"] / max(dt, 1e-9),
             **extra,
         )
@@ -905,26 +1068,70 @@ class Trainer:
     # ------------------------------------------------------------------ fit
 
     def fit(self) -> dict[str, float]:
+        """Train/eval until ``n_epochs``, resuming from the newest checkpoint.
+
+        Resume is cursor-aware: a mid-epoch checkpoint (written every
+        ``checkpoint_every_n_steps``) re-enters its epoch at the exact batch
+        — the data stream fast-forwards, so a killed-and-restarted run
+        replays the identical batch sequence and lands on bit-identical
+        state.  Checkpoints without a cursor sidecar are the legacy
+        epoch-indexed format and resume at the following epoch."""
         cfg = self.config
         start_epoch = 0
+        resume = {"step": 0, "loss_sum": 0.0, "contributed": 0}
         if self._ckpt is not None:
             restored = self._ckpt.restore(self.state)
             if restored is not None:
-                start_epoch, self.state = restored[0] + 1, restored[1]
-                self.logger.log(resumed_from_epoch=restored[0])
-        if cfg.model == "bert4rec" and start_epoch == 0:
-            # pre-training validation sanity floor (torchrec/train.py:159)
-            self.evaluate(epoch=-1)
+                step_id, self.state, cursor = restored
+                if cursor is None:
+                    # legacy epoch-indexed checkpoint: step_id IS the epoch
+                    start_epoch = step_id + 1
+                    self.logger.log(resumed_from_epoch=step_id)
+                elif cursor.get("epoch_complete"):
+                    start_epoch = int(cursor["epoch"]) + 1
+                    self._logged_steps = int(cursor["global_step"])
+                    self.logger.log(resumed_from_epoch=int(cursor["epoch"]),
+                                    global_step=self._logged_steps)
+                else:
+                    start_epoch = int(cursor["epoch"])
+                    resume = {"step": int(cursor["step"]),
+                              "loss_sum": float(cursor.get("loss_sum", 0.0)),
+                              "contributed": int(cursor.get("contributed", 0))}
+                    self._logged_steps = (int(cursor["global_step"])
+                                          - resume["step"])
+                    self.logger.log(resumed_mid_epoch=start_epoch,
+                                    step=resume["step"],
+                                    global_step=int(cursor["global_step"]))
         metrics: dict[str, float] = {}
-        for epoch in range(start_epoch, cfg.n_epochs):
-            self.train_epoch(epoch)
-            metrics = self.evaluate(epoch)
-            if self._ckpt is not None and (
-                (epoch + 1) % cfg.checkpoint_every_n_epochs == 0
-                or epoch == cfg.n_epochs - 1
-            ):
-                self._ckpt.save(epoch, self.state)
-        # final held-out test evaluation (bert4rec; no-op elsewhere)
-        metrics.update(self.evaluate_test())
-        self.logger.close()
+        try:
+            if cfg.model == "bert4rec" and start_epoch == 0 and not resume["step"]:
+                # pre-training validation sanity floor (torchrec/train.py:159)
+                self.evaluate(epoch=-1)
+            for epoch in range(start_epoch, cfg.n_epochs):
+                self.train_epoch(epoch, start_step=resume["step"],
+                                 loss_sum=resume["loss_sum"],
+                                 contributed=resume["contributed"])
+                resume = {"step": 0, "loss_sum": 0.0, "contributed": 0}
+                metrics = self.evaluate(epoch)
+                if self._ckpt is not None and (
+                    (epoch + 1) % cfg.checkpoint_every_n_epochs == 0
+                    or epoch == cfg.n_epochs - 1
+                ):
+                    # checkpoint ids live in the global data-step namespace
+                    # (shared with mid-epoch saves); force overwrites a
+                    # mid-epoch save that landed on the same step
+                    gstep = self._logged_steps
+                    self._ckpt.save(
+                        gstep, self.state, force=True,
+                        cursor={"epoch": epoch, "step": 0,
+                                "epoch_complete": True, "global_step": gstep},
+                    )
+            # final held-out test evaluation (bert4rec; no-op elsewhere)
+            metrics.update(self.evaluate_test())
+        finally:
+            # crash or success: release the JSONL/TB handles and the orbax
+            # manager's background machinery (both leaked on error before)
+            self.logger.close()
+            if self._ckpt is not None:
+                self._ckpt.close()
         return metrics

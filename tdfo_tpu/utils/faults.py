@@ -1,0 +1,150 @@
+"""Deterministic fault injection — the testable half of the fault-tolerance story.
+
+"Training survives preemption by construction" is only a claim until a test
+can crash a real process mid-epoch and assert the resumed run's metrics are
+bit-identical to an uninterrupted one.  This module turns the ``[faults]``
+config section into deterministic, step-keyed fault triggers the trainer and
+the retry layer consult:
+
+  * ``kill_at_step = N``  — hard-kill the process (``os._exit(17)``) when
+    global data step N completes.  With a ``checkpoint_dir``, the kill fires
+    AT MOST ONCE per directory (a ``faults_kill.marker`` sentinel records the
+    firing), so "restart the same command" converges instead of crash-looping
+    — the semantics of a one-off preemption.
+  * ``nan_at_step = N``  — poison the step-N host batch (first float column
+    -> NaN) so the real jitted step produces a non-finite loss and corrupt
+    gradients, exercising the trainer's rollback guard on the true data path.
+  * ``fail_io_nth = N``  — the Nth I/O operation protected by
+    ``tdfo_tpu/utils/retry.py`` raises an injected ``OSError`` (once); the
+    retry's next attempt proceeds, proving backoff+retry end-to-end.
+
+All triggers key on run-global DATA position (batches consumed), which is
+monotone across rollbacks and resumes — ``state.step`` is not (rollback
+rewinds it).  Zero disables a trigger; a process with no faults configured
+pays a single ``is None`` check per site.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["FaultSpec", "FaultInjector", "configure", "active", "KILL_EXIT_CODE"]
+
+KILL_EXIT_CODE = 17  # distinguishes an injected kill from real crashes
+_MARKER = "faults_kill.marker"
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """The ``[faults]`` config section.  All steps are 1-based run-global
+    data steps; 0 disables."""
+
+    kill_at_step: int = 0
+    nan_at_step: int = 0
+    fail_io_nth: int = 0
+
+    def __post_init__(self) -> None:
+        for name in ("kill_at_step", "nan_at_step", "fail_io_nth"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
+
+    def any(self) -> bool:
+        return bool(self.kill_at_step or self.nan_at_step or self.fail_io_nth)
+
+
+class FaultInjector:
+    """Stateful trigger evaluation for one training process."""
+
+    def __init__(self, spec: FaultSpec, workdir: str | Path | None = None):
+        self.spec = spec
+        self.workdir = Path(workdir) if workdir else None
+        self._io_count = 0
+        self._io_fired = False
+
+    # ------------------------------------------------------------- kill
+
+    def kill_due(self, global_step: int) -> bool:
+        """True when the injected preemption should fire at this step.
+        Consults (and honours) the one-shot marker; does NOT exit."""
+        if not self.spec.kill_at_step or global_step < self.spec.kill_at_step:
+            return False
+        if self.workdir is not None and (self.workdir / _MARKER).exists():
+            return False  # already preempted once in this checkpoint lineage
+        return True
+
+    def maybe_kill(self, global_step: int) -> None:
+        """Hard-exit (``os._exit``, no cleanup — a real preemption gives no
+        cleanup either) when the kill trigger is due."""
+        if not self.kill_due(global_step):
+            return
+        if self.workdir is not None:
+            self.workdir.mkdir(parents=True, exist_ok=True)
+            (self.workdir / _MARKER).write_text(
+                f"killed at global step {global_step} at {time.time()}\n"
+            )
+        print(f"[faults] injected kill at global step {global_step}",
+              flush=True)
+        os._exit(KILL_EXIT_CODE)
+
+    # -------------------------------------------------------------- nan
+
+    def nan_due(self, global_step: int) -> bool:
+        return bool(self.spec.nan_at_step) and global_step == self.spec.nan_at_step
+
+    def poison_batch(self, batch: dict[str, np.ndarray],
+                     global_step: int) -> dict[str, np.ndarray]:
+        """Overwrite the first float-typed column with NaN (host-side, before
+        device transfer) so the REAL step computes a non-finite loss — the
+        corrupted-shard / overflow failure mode, injected deterministically."""
+        if not self.nan_due(global_step):
+            return batch
+        for k, v in batch.items():
+            if np.issubdtype(np.asarray(v).dtype, np.floating):
+                out = dict(batch)
+                out[k] = np.full_like(v, np.nan)
+                print(f"[faults] injected NaN into column {k!r} at global "
+                      f"step {global_step}", flush=True)
+                return out
+        raise ValueError(
+            "faults.nan_at_step needs a float-typed batch column to poison; "
+            "this workload ships integer-only batches"
+        )
+
+    # --------------------------------------------------------------- io
+
+    def io_op(self, description: str) -> None:
+        """Called by ``retry_call`` before each protected attempt.  Raises an
+        injected ``OSError`` exactly once, on the configured Nth operation."""
+        if not self.spec.fail_io_nth or self._io_fired:
+            return
+        self._io_count += 1
+        if self._io_count == self.spec.fail_io_nth:
+            self._io_fired = True
+            raise OSError(
+                f"[faults] injected I/O failure on op #{self._io_count} "
+                f"({description})"
+            )
+
+
+_ACTIVE: FaultInjector | None = None
+
+
+def configure(spec: FaultSpec | None,
+              workdir: str | Path | None = None) -> FaultInjector | None:
+    """Install the process-global injector (``None`` / empty spec clears it).
+    The Trainer calls this at construction, so each run re-arms from its own
+    config and stale injectors never leak across tests."""
+    global _ACTIVE
+    _ACTIVE = (
+        FaultInjector(spec, workdir) if spec is not None and spec.any() else None
+    )
+    return _ACTIVE
+
+
+def active() -> FaultInjector | None:
+    return _ACTIVE

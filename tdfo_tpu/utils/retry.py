@@ -1,0 +1,130 @@
+"""Retryable I/O: exponential backoff + jitter, bounded attempts, JSONL log.
+
+The reference stacks assume I/O never fails mid-run (orbax writes, parquet
+opens, TFRecord scans all raise straight through and kill the job).  On
+preemptible TPU fleets the common failures are transient — GCS 5xx, NFS
+staleness, a checkpoint write racing a preemption — and a bounded retry with
+backoff is the difference between "training survived" and "8 hours lost".
+
+Every failure (retried or terminal) is appended to an in-memory ring and,
+when :func:`set_failure_log` configured a path, to a JSONL file — the same
+observability convention as the trainer's ``metrics.jsonl``.
+
+The deterministic fault-injection harness (``tdfo_tpu/utils/faults.py``)
+hooks in here: when a ``fail_io_nth`` fault is armed, the Nth call protected
+by :func:`retry_call` raises an injected ``OSError`` on its first attempt,
+proving the retry path end-to-end in tests without real storage faults.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import json
+import random
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable
+
+__all__ = ["retry_call", "retryable", "set_failure_log", "recent_failures"]
+
+# last N failure records, observable by tests and post-mortems even when no
+# log file is configured
+_RECENT: collections.deque = collections.deque(maxlen=256)
+_LOG_PATH: Path | None = None
+
+
+def set_failure_log(path: str | Path | None) -> None:
+    """Route failure records to a JSONL file (``None`` disables).  The
+    trainer points this at ``<log_dir>/retries.jsonl`` on process 0."""
+    global _LOG_PATH
+    _LOG_PATH = Path(path) if path is not None else None
+
+
+def recent_failures() -> list[dict[str, Any]]:
+    """The in-memory ring of recent failure records (newest last)."""
+    return list(_RECENT)
+
+
+def _record(rec: dict[str, Any]) -> None:
+    _RECENT.append(rec)
+    if _LOG_PATH is not None:
+        try:
+            _LOG_PATH.parent.mkdir(parents=True, exist_ok=True)
+            with open(_LOG_PATH, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # the failure log must never turn a retry into a crash
+
+
+def retry_call(
+    fn: Callable,
+    *args: Any,
+    description: str,
+    attempts: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    retry_on: tuple[type[BaseException], ...] | Iterable[type[BaseException]] = (OSError,),
+    sleep: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    **kwargs: Any,
+):
+    """Call ``fn(*args, **kwargs)``; on a ``retry_on`` exception, back off
+    exponentially (``base_delay * 2**attempt``, capped at ``max_delay``, plus
+    up to ``jitter`` fraction of random spread) and try again, at most
+    ``attempts`` times total.  The final failure re-raises.
+
+    Every failed attempt appends a JSONL record ``{time, description,
+    attempt, attempts, error, delay}`` (see :func:`set_failure_log`).
+
+    ``sleep``/``rng`` are injectable for deterministic tests.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    retry_on = tuple(retry_on)
+    rng = rng or random.Random()
+
+    from tdfo_tpu.utils import faults
+
+    for attempt in range(attempts):
+        try:
+            inj = faults.active()
+            if inj is not None:
+                inj.io_op(description)  # may raise an injected OSError
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            final = attempt == attempts - 1
+            delay = 0.0
+            if not final:
+                delay = min(base_delay * (2 ** attempt), max_delay)
+                delay *= 1.0 + jitter * rng.random()
+            _record({
+                "time": time.time(),
+                "description": description,
+                "attempt": attempt + 1,
+                "attempts": attempts,
+                "error": f"{type(e).__name__}: {e}",
+                "delay": round(delay, 4),
+                "final": final,
+            })
+            if final:
+                raise
+            sleep(delay)
+
+
+def retryable(**retry_kwargs: Any) -> Callable:
+    """Decorator form of :func:`retry_call`.  ``description`` defaults to the
+    wrapped function's qualified name."""
+
+    def deco(fn: Callable) -> Callable:
+        kw = dict(retry_kwargs)
+        kw.setdefault("description", getattr(fn, "__qualname__", repr(fn)))
+
+        @functools.wraps(fn)
+        def wrapped(*args: Any, **kwargs: Any):
+            return retry_call(fn, *args, **kw, **kwargs)
+
+        return wrapped
+
+    return deco

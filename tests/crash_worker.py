@@ -1,0 +1,91 @@
+"""Worker for the crash/resume test (run as a subprocess, NOT pytest).
+
+Usage:
+    python crash_worker.py <spec_json_path>
+
+Spec keys: ``data_dir``, ``checkpoint_dir``, ``log_dir``, ``out_json``,
+``kill_at_step``, ``checkpoint_every_n_steps``, ``local_devices``, and an
+optional ``distributed = {port, nprocs, pid}`` to join a jax.distributed
+cluster (the 2-process variant; both processes hit the lockstep kill at the
+same step boundary).
+
+Spoofs CPU devices, trains one epoch through the SAME Trainer as production
+runs with the ``[faults]`` kill armed, and writes final metrics plus a
+sha256 digest of this process's addressable train-state shards to
+``out_json``.  When the injected kill fires, the process dies via
+``os._exit(KILL_EXIT_CODE)`` and writes nothing — exactly the observable
+behaviour of a real preemption.
+"""
+
+import hashlib
+import json
+import sys
+from pathlib import Path
+
+
+def _digest_state(state) -> str:
+    """sha256 over this process's addressable shards, leaf order fixed by the
+    pytree; deterministic across identical runs on the same mesh."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(state):
+        if isinstance(leaf, jax.Array):
+            for s in leaf.addressable_shards:
+                h.update(np.ascontiguousarray(np.asarray(s.data)).tobytes())
+        elif hasattr(leaf, "dtype"):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+        else:
+            h.update(repr(leaf).encode())
+    return h.hexdigest()
+
+
+def main() -> None:
+    spec = json.loads(Path(sys.argv[1]).read_text())
+
+    from tdfo_tpu.core.mesh import spoof_cpu_devices
+
+    spoof_cpu_devices(int(spec.get("local_devices", 4)))
+
+    import jax
+
+    dist = spec.get("distributed")
+    if dist:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{dist['port']}",
+            num_processes=int(dist["nprocs"]),
+            process_id=int(dist["pid"]),
+        )
+        assert jax.process_count() == int(dist["nprocs"])
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.train.trainer import Trainer
+
+    cfg = read_configs(
+        None,
+        data_dir=spec["data_dir"],
+        model="twotower",
+        n_epochs=1,
+        learning_rate=3e-3,
+        embed_dim=8,
+        per_device_train_batch_size=16,
+        per_device_eval_batch_size=16,
+        shuffle_buffer_size=500,
+        log_every_n_steps=2,
+        size_map=load_size_map(spec["data_dir"]),
+        checkpoint_dir=spec["checkpoint_dir"],
+        checkpoint_every_n_steps=int(spec["checkpoint_every_n_steps"]),
+        faults={"kill_at_step": int(spec["kill_at_step"])},
+    )
+    tr = Trainer(cfg, log_dir=spec["log_dir"])
+    metrics = tr.fit()
+
+    Path(spec["out_json"]).write_text(json.dumps(
+        {"metrics": metrics, "state_digest": _digest_state(tr.state)}
+    ))
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,42 @@ def test_new_knob_validation():
            steps_per_execution=4, streaming=False)
 
 
+def test_faults_table(tmp_path: Path):
+    """The [faults] section maps onto FaultSpec; unknown keys fail loudly
+    like every other config key."""
+    (tmp_path / "config.toml").write_text(
+        "[faults]\nkill_at_step = 7\nnan_at_step = 3\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.faults.kill_at_step == 7
+    assert cfg.faults.nan_at_step == 3
+    assert cfg.faults.fail_io_nth == 0
+    assert cfg.faults.any()
+    # defaults: no faults armed
+    assert not read_configs().faults.any()
+    (tmp_path / "config.toml").write_text("[faults]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="bogus"):
+        read_configs(tmp_path / "config.toml")
+
+
+def test_fault_tolerance_knob_validation():
+    from tdfo_tpu.utils.faults import FaultSpec
+
+    for bad in (
+        dict(checkpoint_every_n_steps=-1),
+        dict(max_bad_shards=-1),
+        dict(nonfinite_tolerance=-1),
+        dict(snapshot_every_n_steps=0),
+    ):
+        with pytest.raises(ValueError):
+            Config(**bad)
+    with pytest.raises(ValueError, match="kill_at_step"):
+        FaultSpec(kill_at_step=-1)
+    # valid combinations construct fine
+    Config(checkpoint_every_n_steps=50, max_bad_shards=2,
+           nonfinite_tolerance=0, snapshot_every_n_steps=10,
+           faults=FaultSpec(fail_io_nth=2))
+
+
 def test_bert4rec_rejects_tfrecord():
     """write_format must DO something for every model: the seq ETL writes
     list-valued columns tfrecord does not carry (VERDICT r3 weak #4)."""

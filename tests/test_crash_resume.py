@@ -1,0 +1,171 @@
+"""Crash/resume acceptance with a REAL process kill (``@pytest.mark.slow``).
+
+The in-process variant lives in tests/test_fault_tolerance.py; this tier
+spawns actual subprocesses (pattern from tests/test_multihost.py) so the kill
+is a genuine ``os._exit`` — no cleanup, no atexit, no flushed buffers — and
+asserts the three-way contract:
+
+  1. the killed run exits with ``KILL_EXIT_CODE`` and leaves a mid-epoch
+     step-granular checkpoint behind,
+  2. restarting the SAME command resumes (the kill marker disarms the fault)
+     and completes,
+  3. the resumed run's final metrics AND full train state are bit-identical
+     to an uninterrupted run's.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = str(Path(__file__).resolve().parents[1])
+WORKER = str(Path(__file__).with_name("crash_worker.py"))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(spec_path: Path) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(spec_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _run_workers(spec_paths: list[Path]) -> tuple[list[int], list[str]]:
+    procs = [_spawn(p) for p in spec_paths]
+    rcs, outs = [], []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            rcs.append(p.returncode)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    return rcs, outs
+
+
+def _run_worker(spec_path: Path) -> tuple[int, str]:
+    rcs, outs = _run_workers([spec_path])
+    return rcs[0], outs[0]
+
+
+@pytest.fixture(scope="module")
+def ctr_data(tmp_path_factory):
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+
+    d = tmp_path_factory.mktemp("gr_crash")
+    write_synthetic_goodreads(d, n_users=80, n_books=120,
+                              interactions_per_user=(15, 40), seed=13)
+    run_ctr_preprocessing(d)
+    return d
+
+
+def test_kill_restart_resumes_bit_identical(ctr_data, tmp_path):
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    def make_spec(name: str, kill: int, ckpt: str) -> tuple[Path, dict]:
+        spec = dict(
+            data_dir=str(ctr_data), checkpoint_dir=str(tmp_path / ckpt),
+            log_dir=str(tmp_path / f"log_{name}"),
+            out_json=str(tmp_path / f"{name}.json"),
+            kill_at_step=kill, checkpoint_every_n_steps=3, local_devices=4,
+        )
+        p = tmp_path / f"{name}_spec.json"
+        p.write_text(json.dumps(spec))
+        return p, spec
+
+    killed_spec, killed = make_spec("killed", kill=5, ckpt="ckpt")
+    rc, out = _run_worker(killed_spec)
+    assert rc == KILL_EXIT_CODE, f"expected injected kill, got rc={rc}\n{out[-2000:]}"
+    assert not Path(killed["out_json"]).exists()  # died before finishing
+    assert (tmp_path / "ckpt" / "faults_kill.marker").exists()
+
+    # restart the SAME command: marker disarms the kill, the run resumes
+    # from the last step-granular checkpoint and completes
+    rc, out = _run_worker(killed_spec)
+    assert rc == 0, f"resumed run failed rc={rc}\n{out[-2000:]}"
+    resumed = json.loads(Path(killed["out_json"]).read_text())
+    log = (tmp_path / "log_killed" / "metrics.jsonl").read_text()
+    recs = [json.loads(l) for l in log.splitlines()]
+    mid = [r for r in recs if "resumed_mid_epoch" in r]
+    assert mid and mid[-1]["step"] > 0, "resume must be mid-epoch, not epoch start"
+
+    ref_spec, ref_s = make_spec("ref", kill=0, ckpt="ckpt_ref")
+    rc, out = _run_worker(ref_spec)
+    assert rc == 0, f"reference run failed rc={rc}\n{out[-2000:]}"
+    ref = json.loads(Path(ref_s["out_json"]).read_text())
+
+    assert resumed["metrics"] == ref["metrics"]
+    assert resumed["state_digest"] == ref["state_digest"]
+
+
+def test_two_process_kill_restart_bit_identical(ctr_data, tmp_path):
+    """The multihost variant (tests/test_multihost.py style): a 2-process
+    jax.distributed cluster is preempted — SPMD lockstep means both workers
+    hit the injected kill at the same step boundary — and a restart of the
+    same pair resumes mid-epoch to bit-identical global metrics and
+    per-process state shards."""
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    def make_pair(name: str, kill: int, ckpt: str) -> list[Path]:
+        port = _free_port()
+        paths = []
+        for pid in range(2):
+            spec = dict(
+                data_dir=str(ctr_data), checkpoint_dir=str(tmp_path / ckpt),
+                log_dir=str(tmp_path / f"log_{name}_p{pid}"),
+                out_json=str(tmp_path / f"{name}_p{pid}.json"),
+                kill_at_step=kill, checkpoint_every_n_steps=3,
+                local_devices=2,
+                distributed=dict(port=port, nprocs=2, pid=pid),
+            )
+            p = tmp_path / f"{name}_p{pid}_spec.json"
+            p.write_text(json.dumps(spec))
+            paths.append(p)
+        return paths
+
+    killed_pair = make_pair("killed2", kill=5, ckpt="ckpt2")
+    rcs, outs = _run_workers(killed_pair)
+    if rcs != [KILL_EXIT_CODE] * 2 and any(
+        "Multiprocess computations aren't implemented" in o for o in outs
+    ):
+        # same backend limitation that fails tests/test_multihost.py on this
+        # jax build; the single-process variant above still covers the path
+        pytest.skip("CPU backend lacks multiprocess collectives")
+    assert rcs == [KILL_EXIT_CODE] * 2, f"rcs={rcs}\n{outs[0][-1500:]}\n{outs[1][-1500:]}"
+    assert (tmp_path / "ckpt2" / "faults_kill.marker").exists()
+
+    # restart the SAME command pair: the marker disarms the kill on both
+    rcs, outs = _run_workers(killed_pair)
+    assert rcs == [0, 0], f"rcs={rcs}\n{outs[0][-1500:]}\n{outs[1][-1500:]}"
+    resumed = [json.loads((tmp_path / f"killed2_p{pid}.json").read_text())
+               for pid in range(2)]
+
+    ref_pair = make_pair("ref2", kill=0, ckpt="ckpt2_ref")
+    rcs, outs = _run_workers(ref_pair)
+    assert rcs == [0, 0], f"rcs={rcs}\n{outs[0][-1500:]}\n{outs[1][-1500:]}"
+    ref = [json.loads((tmp_path / f"ref2_p{pid}.json").read_text())
+           for pid in range(2)]
+
+    # global metrics identical across processes AND across resumed/reference
+    assert resumed[0]["metrics"] == resumed[1]["metrics"]
+    assert resumed[0]["metrics"] == ref[0]["metrics"] == ref[1]["metrics"]
+    # each process's addressable state shards bit-identical to the reference
+    for pid in range(2):
+        assert resumed[pid]["state_digest"] == ref[pid]["state_digest"]

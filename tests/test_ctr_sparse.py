@@ -253,7 +253,8 @@ def test_fused_sparse_state_checkpoint_resume(ctr_data, tmp_path):
     assert len(tr1.state.tables) < 7
     m1 = tr1.fit()
     tr2 = Trainer(_trainer_cfg(d, size_map, n_epochs=2, **common))
-    assert tr2._ckpt.latest_step() == 0
+    s0 = tr2._ckpt.latest_step()
+    assert s0 is not None and tr2._ckpt.read_cursor(s0)["epoch"] == 0
     m2 = tr2.fit()
     assert 0.0 <= m2["auc"] <= 1.0
     assert m2["eval_loss"] <= m1["eval_loss"] * 1.2

@@ -102,10 +102,14 @@ def test_checkpoint_resume_roundtrip(prepared_dir, tmp_path):
         checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every_n_epochs=1,
     )
     m1 = Trainer(read_configs(None, n_epochs=1, **common)).fit()
-    # second trainer resumes from epoch 0's checkpoint and trains one more
+    # second trainer resumes from epoch 0's checkpoint and trains one more;
+    # checkpoint ids are global data steps, with the epoch recorded in the
+    # cursor sidecar
     tr2 = Trainer(read_configs(None, n_epochs=2, **common))
     restored = tr2._ckpt.latest_step()
-    assert restored == 0
+    assert restored is not None
+    cursor = tr2._ckpt.read_cursor(restored)
+    assert cursor["epoch"] == 0 and cursor["epoch_complete"]
     m2 = tr2.fit()
     assert m2["eval_loss"] <= m1["eval_loss"] * 1.1  # did not regress from scratch
 
@@ -374,16 +378,20 @@ def test_preempted_save_does_not_poison_resume(prepared_dir, tmp_path):
     tr = Trainer(cfg)
     tr.fit()  # writes a complete checkpoint for epoch 0
     mgr = CheckpointManager(tmp_path / "ckpt")
-    assert mgr.latest_step() == 0
+    s0 = mgr.latest_step()
+    assert s0 is not None
+    assert mgr.read_cursor(s0)["epoch"] == 0
     mgr.close()
-    # simulate a preemption mid-save of epoch 1: orbax-style in-progress dir
-    # plus a stray empty step dir with no committed payload
-    (tmp_path / "ckpt" / "1.orbax-checkpoint-tmp-1234567").mkdir()
+    # simulate a preemption mid-save of a later step: orbax-style in-progress
+    # dir with no committed payload
+    (tmp_path / "ckpt" / f"{s0 + 1}.orbax-checkpoint-tmp-1234567").mkdir()
     tr2 = Trainer(cfg.replace(n_epochs=2))
-    assert tr2._ckpt.latest_step() == 0  # incomplete save ignored
+    assert tr2._ckpt.latest_step() == s0  # incomplete save ignored
     m = tr2.fit()  # resumes from epoch 0 and completes epoch 1
     assert 0.0 <= m["auc"] <= 1.0
-    assert tr2._ckpt.latest_step() == 1
+    s1 = tr2._ckpt.latest_step()
+    assert s1 > s0
+    assert tr2._ckpt.read_cursor(s1)["epoch"] == 1
 
 
 def test_checkpoint_layout_version_guard(tmp_path):
@@ -404,8 +412,8 @@ def test_checkpoint_layout_version_guard(tmp_path):
     # roundtrip at the current version works and preserves values
     mgr = CheckpointManager(tmp_path / "ok")
     mgr.save(0, state)
-    step, restored = mgr.restore(state)
-    assert step == 0
+    step, restored, cursor = mgr.restore(state)
+    assert step == 0 and cursor is None  # no cursor saved with this step
     np.testing.assert_array_equal(np.asarray(restored["w"]),
                                   np.asarray(state["w"]))
     mgr.close()
