@@ -228,18 +228,28 @@ def build_train_bench(batch_size: int, embed_dim: int):
 # provably irreducible.
 
 
-def _make_criteo_host_batch(rng: np.random.Generator, b: int) -> dict[str, np.ndarray]:
-    out: dict[str, np.ndarray] = {
-        f"cat_{i}": rng.integers(0, v, b, dtype=np.int32)
-        for i, v in enumerate(CRITEO_KAGGLE_VOCABS)
-    }
+def _make_criteo_host_batch(rng: np.random.Generator, b: int,
+                            powerlaw: bool = False) -> dict[str, np.ndarray]:
+    if powerlaw:
+        from tdfo_tpu.data.synthetic import zipf_ids
+
+        out: dict[str, np.ndarray] = {
+            f"cat_{i}": zipf_ids(rng, v, b)
+            for i, v in enumerate(CRITEO_KAGGLE_VOCABS)
+        }
+    else:
+        out = {
+            f"cat_{i}": rng.integers(0, v, b, dtype=np.int32)
+            for i, v in enumerate(CRITEO_KAGGLE_VOCABS)
+        }
     for i in range(13):
         out[f"cont_{i}"] = rng.random(b, dtype=np.float32)
     out["label"] = rng.integers(0, 2, b).astype(np.float32)
     return out
 
 
-def build_criteo_train_bench(batch_size: int, embed_dim: int):
+def build_criteo_train_bench(batch_size: int, embed_dim: int,
+                             hot_vocab: int = 0, powerlaw: bool = False):
     """DLRM over the Criteo-Kaggle table profile (26 tables, 33.76M rows):
     the BASELINE.json north-star metric measured directly.  Big tables live
     in ONE fused rowwise-adagrad fat-line stack (4 packed rows per 128-lane
@@ -247,6 +257,15 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
     tables in one plain 2D stack; dedup_lookup shares one sort between the
     forward gather and the update (fbgemm fused-TBE parity, the huge-table
     configuration: one f32 accumulator per row).
+
+    ``hot_vocab > 0`` enables the frequency-partitioned hot/cold mode
+    (``parallel/embedding.py``): every table's ``[0, min(hot_vocab, V))``
+    prefix — the Criteo-ETL frequency-ranked layout — becomes a replicated
+    hot head updated scatter-free via one-hot MXU contractions, and the
+    batches switch to power-law (zipf-ranked) ids so the lookup traffic
+    concentrates on the head like real Criteo traffic does.  ``powerlaw``
+    alone keeps the single-table layout under the same skewed traffic —
+    the honest ablation baseline.
     """
     import jax
     import jax.numpy as jnp
@@ -273,10 +292,16 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
     # remains the right choice for memory-bound tables (optimizer state
     # packed in-line) and for small touch counts (twotower d=64 adam);
     # docs/BUDGET.md carries the full measured decomposition.
+    powerlaw = powerlaw or hot_vocab > 0
+    hot_ids = None
+    if hot_vocab > 0:
+        hot_ids = {c: np.arange(min(hot_vocab, v), dtype=np.int32)
+                   for c, v in size_map.items()}
     coll = ShardedEmbeddingCollection(
         generic_embedding_specs(size_map, cats, embed_dim, "row",
                                 fused_threshold=None),
         mesh=mesh, stack_tables=True, fused_kind="rowwise_adagrad",
+        hot_ids=hot_ids,
     )
     # shapes only — the real tables are built INSIDE the jitted chain (a
     # per-chain constant the differencing cancels): an 8.65 GB table passed
@@ -316,15 +341,30 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
         return lambda stack: chain(dense, stack)
 
     unique_rows_per_step: list[float] = []
+    hot_k = {c: coll.hot_count(f"{c}_embed") for c in cats}
+    hot_info = {
+        "enabled": hot_vocab > 0, "hot_vocab": hot_vocab,
+        "powerlaw": powerlaw,
+        "fully_hot_tables": sum(coll.hot_full(f"{c}_embed") for c in cats),
+        "hit_rates": [],
+    }
 
     def make_args(k, seed):
         r = np.random.default_rng(seed)
-        host = _make_criteo_host_batch(r, b * k)
+        host = _make_criteo_host_batch(r, b * k, powerlaw=powerlaw)
         ids = {c: host[c].reshape(k, b) for c in cats}
         for step in range(k):
-            unique_rows_per_step.append(
-                float(sum(len(np.unique(v[step])) for v in ids.values()))
-            )
+            # COLD uniques only: hot hits never reach the scatter path, so
+            # the roofline floor must not charge row traffic for them
+            unique_rows_per_step.append(float(sum(
+                len(np.unique(v[step][v[step] >= hot_k[c]]))
+                for c, v in ids.items()
+            )))
+        if hot_vocab > 0:
+            # lookup-mass fraction landing on the hot heads (power-law
+            # traffic concentrates here — the number the split banks on)
+            hits = sum(int((v < hot_k[c]).sum()) for c, v in ids.items())
+            hot_info["hit_rates"].append(hits / (len(cats) * k * b))
         return (_stack_batches(mesh, host, k, b),)
 
     dense_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(dense))
@@ -334,14 +374,18 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int):
         # the fused update reads+writes packed 128-lane lines (table rows +
         # accumulator cells together); best case every touched row shares
         # its line fully -> w lanes x 4B x 2 directions per row.  Plus the
-        # dense 6x AdamW sweep.
+        # dense 6x AdamW sweep, and — in hot/cold mode — the hot heads'
+        # dense masked RMW (whole [K, D] table + [K] rowwise accumulator,
+        # read and write, every step).
         from tdfo_tpu.ops.pallas_kernels import line_layout
 
         lay = line_layout(embed_dim, "rowwise_adagrad")
         u_mean = float(np.mean(unique_rows_per_step)) if unique_rows_per_step else 0.0
-        return 2.0 * u_mean * lay.w * 4.0 + 6.0 * dense_bytes
+        hot_bytes = sum(2.0 * 4.0 * (k_ * embed_dim + k_)
+                        for k_ in hot_k.values())
+        return 2.0 * u_mean * lay.w * 4.0 + 6.0 * dense_bytes + hot_bytes
 
-    return run, make_args, b, floor_bytes_fn, flops_per_example
+    return run, make_args, b, floor_bytes_fn, flops_per_example, hot_info
 
 
 def build_sparse_train_bench(batch_size: int, embed_dim: int,
@@ -598,6 +642,15 @@ def main() -> None:
                          "Criteo-Kaggle tables, 33.76M rows, stacked, "
                          "rowwise-adagrad)")
     ap.add_argument("--skip-big-table", action="store_true")
+    ap.add_argument("--hot-vocab", type=int, default=0,
+                    help="dlrm-criteo only: split every table's [0, K) "
+                         "frequency-ranked prefix into a replicated hot head "
+                         "(scatter-free one-hot MXU updates) and switch the "
+                         "batches to power-law ids")
+    ap.add_argument("--powerlaw", action="store_true",
+                    help="dlrm-criteo only: power-law (zipf-ranked) ids "
+                         "WITHOUT the hot/cold split — the ablation baseline "
+                         "for --hot-vocab")
     args = ap.parse_args()
     if args.model == "dlrm-criteo" and args.embed_dim > 32:
         ap.error("dlrm-criteo: use --embed-dim 16 (the standard Kaggle-DLRM "
@@ -605,16 +658,21 @@ def main() -> None:
     if args.dense and args.model != "twotower":
         # validate BEFORE measuring: a bad combination must not waste a run
         ap.error("--model is only valid for the sparse headline (drop --dense)")
+    if (args.hot_vocab or args.powerlaw) and args.model != "dlrm-criteo":
+        ap.error("--hot-vocab/--powerlaw require --model dlrm-criteo")
 
     import jax
 
+    hot_info = None
     if args.dense:
         run, make_args, global_batch, floor_bytes, flops_per_ex = build_train_bench(
             args.batch_size, args.embed_dim
         )
     elif args.model == "dlrm-criteo":
-        run, make_args, global_batch, floor_bytes, flops_per_ex = (
-            build_criteo_train_bench(args.batch_size, args.embed_dim)
+        run, make_args, global_batch, floor_bytes, flops_per_ex, hot_info = (
+            build_criteo_train_bench(args.batch_size, args.embed_dim,
+                                     hot_vocab=args.hot_vocab,
+                                     powerlaw=args.powerlaw)
         )
     else:
         run, make_args, global_batch, floor_bytes, flops_per_ex = (
@@ -668,6 +726,12 @@ def main() -> None:
         # a different model family must never be compared against the
         # twotower baseline record (config equality gates vs_baseline)
         bench_config["model"] = model_name
+    if args.hot_vocab or args.powerlaw:
+        # hot/cold and power-law traffic change the workload: the config
+        # keys gate vs_baseline so a skewed-traffic run never claims a
+        # speedup over the uniform-traffic baseline record
+        bench_config["hot_vocab"] = args.hot_vocab
+        bench_config["powerlaw"] = True
     record = {
         "metric": f"{model_name.replace('-', '_')}_train_examples_per_sec_per_chip",
         "value": round(examples_per_sec_per_chip, 1),
@@ -683,6 +747,16 @@ def main() -> None:
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,
     }
+    if hot_info is not None and (hot_info["enabled"] or hot_info["powerlaw"]):
+        record["hot_cold"] = {
+            "enabled": hot_info["enabled"],
+            "hot_vocab": hot_info["hot_vocab"],
+            "powerlaw": hot_info["powerlaw"],
+            "fully_hot_tables": hot_info["fully_hot_tables"],
+            "hit_rate": (round(float(np.mean(hot_info["hit_rates"])), 4)
+                         if hot_info["hit_rates"] else None),
+            "step_ms": round(sec_per_step * 1e3, 3),
+        }
     # only the DEFAULT headline config may claim the auto-written baseline
     # slot (a first-ever --model dlrm run must not disable twotower
     # regression tracking); explicit --write-baseline always wins

@@ -29,7 +29,14 @@ from typing import Any, Mapping
 
 from tdfo_tpu.utils.faults import FaultSpec
 
-__all__ = ["Config", "MeshSpec", "FaultSpec", "read_configs", "load_size_map"]
+__all__ = [
+    "Config",
+    "MeshSpec",
+    "FaultSpec",
+    "EmbeddingsSpec",
+    "read_configs",
+    "load_size_map",
+]
 
 
 @dataclass(frozen=True)
@@ -52,6 +59,34 @@ class MeshSpec:
 
     def sizes(self) -> tuple[int, ...]:
         return (self.data, self.model, self.seq)
+
+
+@dataclass(frozen=True)
+class EmbeddingsSpec:
+    """``[embeddings]`` config table: frequency-partitioned hot/cold
+    embedding storage (FAE / Neo-style popularity partitioning; torchrec
+    ``MANAGED_CACHING`` analogue on a chip without SparseCore).
+
+    ``hot_vocab`` > 0 enables the mode: preprocessing emits per-table
+    hot-id sets (``hot_ids.json`` next to the parquet shards) of at most
+    ``hot_vocab`` ids each, picked as the smallest frequency-ranked prefix
+    covering ``hot_fraction`` of that column's lookup mass; at build time
+    each table with a hot set splits into a small replicated hot table
+    (scatter-free one-hot MXU update) and the residual cold table (the
+    existing dedupe + row-scatter path over a smaller touched set).
+    ``hot_vocab = 0`` disables the mode entirely (single-table storage,
+    the default).
+    """
+
+    # per-table cap on the hot-id set size.  Keep <= ~16384: the one-hot
+    # MXU segment-sum that makes hot updates scatter-free costs ~100-350 us
+    # for vocabs 5k-16k on v5e and grows with the hot vocab.  0 disables.
+    hot_vocab: int = 0
+    # lookup-mass coverage target for the frequency pass: the hot set is
+    # the smallest frequency-ranked id prefix whose train-split lookup
+    # share reaches this fraction (then capped at hot_vocab).  Power-law id
+    # traffic typically reaches 0.9 with a tiny prefix.
+    hot_fraction: float = 0.9
 
 
 @dataclass(frozen=True)
@@ -166,10 +201,14 @@ class Config:
     # vocab size above which DMP-regime tables use fused fat-line storage
     # (ops/pallas_kernels.line_layout + the in-place DMA update kernel,
     # available for EVERY sparse_optimizer kind); smaller tables take the
-    # gather/scatter or one-hot MXU tiers.  0 fuses every table.  The kernel
+    # gather/scatter or one-hot MXU tiers.  0 fuses every table; -1 disables
+    # fused storage entirely (every table stays plain 2D — the measured-
+    # faster choice at the DLRM-Criteo profile, docs/BUDGET.md).  The kernel
     # choice itself is automatic per backend — there is no "use pallas"
     # switch to misconfigure.
     fused_table_threshold: int = 16384
+    # [embeddings] table: frequency-partitioned hot/cold storage knobs
+    embeddings: EmbeddingsSpec = field(default_factory=EmbeddingsSpec)
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---
@@ -297,13 +336,30 @@ class Config:
             raise ValueError("snapshot_every_n_steps must be >= 1")
         if not self.streaming and self.write_format != "parquet":
             raise ValueError("streaming=false (map-style) requires parquet data")
+        if self.fused_table_threshold < -1:
+            raise ValueError(
+                "fused_table_threshold must be >= 0 (0 = fuse every table) "
+                "or exactly -1 (disable fused storage)")
+        if self.embeddings.hot_vocab < 0:
+            raise ValueError("hot_vocab must be >= 0 (0 = hot/cold disabled)")
+        if not (0.0 < self.embeddings.hot_fraction <= 1.0):
+            raise ValueError("hot_fraction must be in (0, 1]")
+        if self.embeddings.hot_vocab > 0 and self.lookup_mode != "gspmd":
+            raise ValueError(
+                "hot/cold embedding storage (hot_vocab > 0) composes with "
+                "lookup_mode \"gspmd\" only: hot tables are replicated and "
+                "routed inside the jitted step, which the explicit psum/"
+                "alltoall shard_map programs do not carry")
 
     @property
     def effective_fused_threshold(self) -> int | None:
-        """Vocab threshold for fused fat-line storage.  The packed line
-        geometry adapts to the optimizer kind
+        """Vocab threshold for fused fat-line storage, or ``None`` when
+        ``fused_table_threshold = -1`` disables fusion outright.  The packed
+        line geometry adapts to the optimizer kind
         (``ops/pallas_kernels.line_layout``), so every sparse-optimizer
         kind gets the fused in-place DMA update path."""
+        if self.fused_table_threshold == -1:
+            return None
         return self.fused_table_threshold
 
     @property
@@ -328,6 +384,7 @@ def load_size_map(data_dir: Path) -> dict[str, int]:
 _CONFIG_FIELDS = {f.name for f in dataclasses.fields(Config)}
 _MESH_FIELDS = {f.name for f in dataclasses.fields(MeshSpec)} - {"axis_names"}
 _FAULT_FIELDS = {f.name for f in dataclasses.fields(FaultSpec)}
+_EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
 
 
 def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
@@ -364,6 +421,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                 f"unknown faults config keys: {sorted(unknown_faults)}")
         faults = FaultSpec(**faults_raw)
 
+    emb_raw = raw.pop("embeddings", {})
+    if isinstance(emb_raw, EmbeddingsSpec):
+        embeddings = emb_raw
+    else:
+        unknown_emb = set(emb_raw) - _EMBEDDINGS_FIELDS
+        if unknown_emb:
+            raise ValueError(
+                f"unknown embeddings config keys: {sorted(unknown_emb)}")
+        embeddings = EmbeddingsSpec(**emb_raw)
+
     unknown = set(raw) - _CONFIG_FIELDS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -374,7 +441,7 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
         if key in raw:
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
-    cfg = Config(mesh=mesh, faults=faults, **raw)
+    cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

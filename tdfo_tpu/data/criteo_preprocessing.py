@@ -68,8 +68,20 @@ def run_criteo_preprocessing(
     file_num: int = FILE_NUM,
     seed: int = 42,
     chunksize: int = 500_000,
+    hot_vocab: int = 0,
+    hot_fraction: float = 0.9,
 ) -> dict[str, int]:
-    """TSV -> parquet shards + size_map.json.  Returns the size map."""
+    """TSV -> parquet shards + size_map.json.  Returns the size map.
+
+    ``hot_vocab > 0`` additionally emits the hot/cold remap artifact
+    (``hot_ids.json``, see ``tdfo_tpu/data/hot_ids.py``) from the SAME
+    pass-1 frequency counts the vocab build consumes — no extra scan.
+    Because this ETL assigns ids 1.. by descending frequency (0 = OOV,
+    which absorbs the below-threshold + missing mass and usually ranks in
+    the head), hot sets are contiguous ``[0, K)`` prefixes whenever the
+    OOV mass makes the cut — the layout the collection detects and remaps
+    branch-free with one compare (otherwise one sort-method
+    searchsorted)."""
     data_dir = Path(data_dir)
     src = data_dir / source
 
@@ -98,6 +110,24 @@ def run_criteo_preprocessing(
         size_map[c] = len(kept) + 1
     with open(data_dir / "size_map.json", "w") as f:
         json.dump(size_map, f, indent=4)
+
+    if hot_vocab > 0:
+        from tdfo_tpu.data.hot_ids import hot_ids_from_counts, write_hot_ids
+
+        per_table: dict[str, "np.ndarray"] = {}
+        coverage: dict[str, float] = {}
+        for i, c in enumerate(CRITEO_CATEGORICAL):
+            kept_counts = [n for _, n in counts[i].most_common() if n >= min_freq]
+            id_counts = np.zeros(size_map[c], np.int64)
+            # id 0 (OOV) folds the below-threshold + missing lookup mass:
+            # every row contributes exactly one lookup per column
+            id_counts[0] = n_rows - sum(kept_counts)
+            id_counts[1:] = kept_counts
+            per_table[c] = hot_ids_from_counts(
+                id_counts, hot_vocab=hot_vocab, hot_fraction=hot_fraction)
+            coverage[c] = float(id_counts[per_table[c]].sum() / n_rows)
+        write_hot_ids(data_dir, per_table, hot_vocab=hot_vocab,
+                      hot_fraction=hot_fraction, coverage=coverage)
 
     # ---- pass 2: transform, split by time order, STREAM to shards --------
     # Rows append to open parquet writers as they stream past — no

@@ -185,10 +185,20 @@ def write_parquet_shards(
 
 def run_ctr_preprocessing(data_dir: str | Path, *, file_num: int = FILE_NUM,
                           seed: int = 42,
-                          write_format: str = "parquet") -> dict[str, int]:
+                          write_format: str = "parquet",
+                          hot_vocab: int = 0,
+                          hot_fraction: float = 0.9) -> dict[str, int]:
     """Full ETL: raw goodreads files -> parquet or tfrecord shards +
     size_map.json (``write_format`` dispatch parity,
-    ``tensorflow2/data.py:70-105``)."""
+    ``tensorflow2/data.py:70-105``).
+
+    ``hot_vocab > 0`` also emits the hot/cold remap artifact
+    (``tdfo_tpu/data/hot_ids.py``) for the two power-law tables — user and
+    item — from TRAIN-split interaction frequencies.  Unlike the Criteo
+    ETL, these vocabs are sorted-unique (NOT frequency-ranked), so the hot
+    sets are genuine scattered subsets exercising the searchsorted remap
+    path.  The small book-categorical tables are left unsplit (each is
+    either fully hot or too small to matter)."""
     data_dir = Path(data_dir)
     book_features, size_map = get_book_features(data_dir)
     with open(data_dir / "size_map.json", "w") as f:
@@ -201,6 +211,23 @@ def run_ctr_preprocessing(data_dir: str | Path, *, file_num: int = FILE_NUM,
         raise ValueError("interaction user_id outside [0, n_users) of user_id_map")
     if interactions["book_id"].max() >= size_map["item"] or interactions["book_id"].min() < 0:
         raise ValueError("interaction book_id outside [0, n_items) of book_id_map")
+    if hot_vocab > 0:
+        from tdfo_tpu.data.hot_ids import hot_ids_from_counts, write_hot_ids
+
+        train_pairs = split_interactions(interactions, True)
+        per_table, coverage = {}, {}
+        for col, vocab_key in (("user_id", "user"), ("item_id", "item")):
+            src = "user_id" if col == "user_id" else "book_id"
+            id_counts = np.zeros(size_map[vocab_key], np.int64)
+            vc = train_pairs[src].value_counts()
+            id_counts[vc.index.to_numpy()] = vc.to_numpy()
+            per_table[col] = hot_ids_from_counts(
+                id_counts, hot_vocab=hot_vocab, hot_fraction=hot_fraction)
+            total = max(int(id_counts.sum()), 1)
+            coverage[col] = float(id_counts[per_table[col]].sum() / total)
+        write_hot_ids(data_dir, per_table, hot_vocab=hot_vocab,
+                      hot_fraction=hot_fraction, coverage=coverage)
+
     for prefix, is_train in (("train", True), ("eval", False)):
         pairs = split_interactions(interactions, is_train)
         if write_format == "parquet":

@@ -68,7 +68,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "preprocess-criteo":
         from tdfo_tpu.data.criteo_preprocessing import run_criteo_preprocessing
 
-        size_map = run_criteo_preprocessing(cfg.data_dir, seed=cfg.seed)
+        size_map = run_criteo_preprocessing(
+            cfg.data_dir, seed=cfg.seed,
+            hot_vocab=cfg.embeddings.hot_vocab,
+            hot_fraction=cfg.embeddings.hot_fraction,
+        )
         print(f"size_map: {{{len(size_map)} tables, "
               f"max vocab {max(size_map.values())}}}")
         return 0
@@ -76,7 +80,9 @@ def main(argv: list[str] | None = None) -> int:
         from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
 
         size_map = run_ctr_preprocessing(
-            cfg.data_dir, seed=cfg.seed, write_format=cfg.write_format
+            cfg.data_dir, seed=cfg.seed, write_format=cfg.write_format,
+            hot_vocab=cfg.embeddings.hot_vocab,
+            hot_fraction=cfg.embeddings.hot_fraction,
         )
         print(f"size_map: {size_map}")
         return 0

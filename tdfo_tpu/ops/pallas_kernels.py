@@ -751,8 +751,11 @@ def fat_line_update(
     together, the fbgemm TBE structure), the optimizer math on the packed
     lanes, and line DMAs straight back into the SAME buffer
     (``input_output_aliases`` — the caller's array is donated).  Sentinel
-    lines skip BOTH their read and their write, so over-provisioned
-    capacity (slots past the distinct-line count) costs ~nothing.  No XLA
+    lines deliberately issue an UNCONDITIONAL read of line 0 (a per-line
+    when-region on the start+wait costs scalar-core time on every block,
+    which outweighs skipping the rare tail reads) and skip only their
+    write-back, so over-provisioned capacity (slots past the distinct-line
+    count) costs one redundant read DMA per slot and no writes.  No XLA
     scatter anywhere — scatters serialise at ~170 ns/row on v5e while the
     double-buffered DMA stream amortises to ~17-35 ns/line.
 
@@ -1121,15 +1124,20 @@ def fat_line_update_routed(
                     def _(cp=cp):
                         cp.start()
 
-        # the final TWO blocks' writes have no later block to drain them
+        # the final TWO blocks' writes have no later block to drain them.
+        # A one-block grid has no off-parity block at all: statically skip
+        # parity 1 there — its would-be block index is -1, and merely
+        # CONSTRUCTING write_copy(-1, ...) loads ids_ref at a negative SMEM
+        # index before any @pl.when guard could suppress it.  For nblocks
+        # >= 2, i == nsteps - 1 >= 1 so both parities index real blocks.
         @pl.when(i == nsteps - 1)
         def _():
-            for p2 in (0, 1):
+            for p2 in ((0,) if nblocks == 1 else (0, 1)):
                 blk = jnp.where(i % 2 == p2, i, i - 1)
                 for q in range(lines_per_step):
                     ok, cp = write_copy(blk, p2, q)
 
-                    @pl.when(ok & (blk >= 0))
+                    @pl.when(ok)
                     def _(cp=cp):
                         cp.wait()
 

@@ -46,6 +46,7 @@ from typing import Mapping
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdfo_tpu.core.mesh import MODEL_AXIS, shard_map
@@ -113,6 +114,20 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _a2a_bucket_cap(n: int, m: int, cf: float | None) -> int:
+    """Per-owner send-bucket capacity of the alltoall lookup program for a
+    local batch of ``n`` ids over ``m`` shards under capacity factor ``cf``
+    (``None`` = exact worst case ``n``).  Bounded buckets round up to a
+    sublane-friendly multiple of 8, never past ``n``.  The ONE definition
+    shared by ``_lookup_alltoall`` (which sizes the real send buffers) and
+    ``a2a_overflow`` (which counts dropped ids) — any drift between the two
+    would silently mis-report the knob's failure mode."""
+    cap = n if cf is None else min(n, max(1, int(cf * n / m)))
+    if cap < n:
+        cap = min(n, -(-cap // 8) * 8)
+    return cap
+
+
 class ShardedEmbeddingCollection:
     """A set of embedding tables with mesh shardings + lookup programs.
 
@@ -130,6 +145,7 @@ class ShardedEmbeddingCollection:
         a2a_capacity_factor: float | None = None,
         stack_tables: bool = False,
         fused_kind: str = "adam",
+        hot_ids: Mapping[str, np.ndarray] | None = None,
     ):
         """``a2a_capacity_factor``: per-shard send-bucket capacity for the
         alltoall lookup program, as a multiple of the balanced share
@@ -157,7 +173,22 @@ class ShardedEmbeddingCollection:
         storage is itself the opt-in (``fused_table_threshold``), and the
         checkpoint layout stamp (``train/checkpoint.py LAYOUT_VERSION``)
         refuses cross-layout resumes, so the stacking's state-key change
-        cannot corrupt an old run silently."""
+        cannot corrupt an old run silently.
+
+        ``hot_ids``: frequency-partitioned hot/cold mode (fbgemm
+        MANAGED_CACHING / FAE analogue, ``tdfo_tpu/data/hot_ids.py``) —
+        a mapping of table OR feature name to the table's sorted hot-id
+        array (the power-law head, K <= ~16k ids covering most lookup
+        mass).  Each listed table splits into a small REPLICATED hot head
+        ``{name}__hot`` ([K, D], its own ``init()`` entry, updated
+        scatter-free via one-hot MXU contractions in the train step) and
+        the unchanged cold array (hot rows stay as never-touched storage,
+        so sharding plans, stacking and checkpoint shapes are identical to
+        a non-hot/cold run).  Lookups route branch-free: contiguous
+        ``[0, K)`` hot prefixes (the Criteo ETL layout) remap with one
+        compare, general sets with one ``searchsorted(method="sort")``.
+        Hot/cold composes with lookup mode ``gspmd`` only, and only with
+        plain (non-fused) row/replicated tables."""
         from tdfo_tpu.ops.pallas_kernels import line_layout
 
         self.fused_kind = fused_kind
@@ -269,6 +300,104 @@ class ShardedEmbeddingCollection:
                     self._stack_rows[s.name] = (offsets[s.name], slot_rows * m)
                 self._groups[f"__stack_{dim}"] = group
 
+        # hot/cold split state: table name -> sorted hot ids, plus the two
+        # static remap classifications (exact [0, K) prefix -> one compare;
+        # K == vocab -> no cold side at all)
+        self.hot_ids: dict[str, np.ndarray] = {}
+        self._hot_prefix: dict[str, bool] = {}
+        self._hot_full: dict[str, bool] = {}
+        for key, ids in (hot_ids or {}).items():
+            tname = self._feature_to_table.get(key, key)
+            spec = self.specs.get(tname)
+            if spec is None:
+                raise KeyError(
+                    f"hot_ids key {key!r} names neither a table nor a feature")
+            arr = np.asarray(ids, dtype=np.int32)
+            if arr.ndim != 1 or arr.size == 0 or (
+                    arr.size > 1 and np.any(np.diff(arr) <= 0)):
+                raise ValueError(
+                    f"table {tname!r}: hot ids must be a non-empty sorted "
+                    "unique 1D array")
+            if arr[0] < 0 or arr[-1] >= spec.num_embeddings:
+                raise ValueError(
+                    f"table {tname!r}: hot ids outside [0, "
+                    f"{spec.num_embeddings})")
+            if spec.fused or spec.sharding not in ("row", "replicated"):
+                raise ValueError(
+                    f"table {tname!r}: hot/cold supports plain (non-fused) "
+                    f"row/replicated tables; got fused={spec.fused}, "
+                    f"sharding={spec.sharding!r}")
+            if tname in self.hot_ids:
+                raise ValueError(f"table {tname!r} given two hot-id sets")
+            if self.hot_array_name(tname) in self.specs:
+                raise ValueError(
+                    f"table name {self.hot_array_name(tname)!r} collides "
+                    f"with the hot head array of {tname!r}")
+            self.hot_ids[tname] = arr
+            k = int(arr.shape[0])
+            self._hot_prefix[tname] = bool(arr[-1] == k - 1)  # == arange(k)
+            self._hot_full[tname] = k == spec.num_embeddings
+
+    # ----------------------------------------------------------- hot/cold
+
+    @staticmethod
+    def hot_array_name(tname: str) -> str:
+        """``init()`` pytree key of a hot table's head array."""
+        return f"{tname}__hot"
+
+    def hot_tables(self) -> tuple[str, ...]:
+        """Logical table names with a hot/cold split (sorted)."""
+        return tuple(sorted(self.hot_ids))
+
+    def hot_count(self, tname: str) -> int:
+        """Hot-head rows of ``tname`` (0 when the table is not split)."""
+        ids = self.hot_ids.get(tname)
+        return 0 if ids is None else int(ids.shape[0])
+
+    def hot_full(self, tname: str) -> bool:
+        """True when EVERY id of ``tname`` is hot: the cold side is dead —
+        the train step statically skips its gather, dedupe and update."""
+        return self._hot_full.get(tname, False)
+
+    def hot_digest(self) -> dict[str, str]:
+        """Per-table hot-set fingerprints for the checkpoint ``stamps``
+        sidecar (empty when no table is split)."""
+        from tdfo_tpu.data.hot_ids import hot_ids_digest
+
+        return hot_ids_digest(self.hot_ids) if self.hot_ids else {}
+
+    def route_ids(self, feature: str, ids: jax.Array):
+        """Split a feature's raw ids into ``(hot_pos, cold_ids)``.
+
+        ``hot_pos[i]`` is the id's slot in the hot head, -1 for cold or
+        padding ids; ``cold_ids[i]`` is the original id with hot hits
+        replaced by -1 (the existing negative-id padding semantics: cold
+        gathers clamp them, dedupe drops them, one-hot zeroes them — no
+        new masking machinery anywhere downstream).  For an unsplit table
+        returns ``(None, ids)``.  Remap is branch-free: exact ``[0, K)``
+        prefixes pay one compare, general sets one
+        ``searchsorted(method="sort")`` (0.14 vs 0.86 ms default at 8k on
+        v5e) against the <= ~16k-entry sorted hot-id constant."""
+        tname = self._feature_to_table.get(feature, feature)
+        hids = self.hot_ids.get(tname)
+        if hids is None:
+            return None, ids
+        k = hids.shape[0]
+        neg = ids < 0
+        if self._hot_full[tname]:
+            return jnp.where(neg, -1, ids), jnp.full_like(ids, -1)
+        if self._hot_prefix[tname]:
+            hit = (~neg) & (ids < k)
+            hot_pos = jnp.where(hit, ids, -1)
+        else:
+            sorted_hot = jnp.asarray(hids)  # [K] device constant
+            pos = jnp.clip(
+                jnp.searchsorted(sorted_hot, ids, method="sort"), 0, k - 1
+            ).astype(jnp.int32)
+            hit = (~neg) & (jnp.take(sorted_hot, pos) == ids)
+            hot_pos = jnp.where(hit, pos, -1)
+        return hot_pos, jnp.where(hit, -1, ids)
+
     # ---------------------------------------------------------------- init
 
     def fat_layout(self, d: int):
@@ -365,6 +494,18 @@ class ShardedEmbeddingCollection:
                           else P())
                 arr = jax.device_put(arr, NamedSharding(self.mesh, spec_p))
             tables[gname] = arr
+        # hot heads: a GATHER of the already-initialised cold rows (no extra
+        # rng keys), so a hot/cold run's initial effective tables are
+        # bit-identical to the same-seed non-hot/cold run — the property the
+        # trajectory-equivalence tests assert.  The duplicated cold rows
+        # become dead storage (never gathered, never updated).
+        for tname in sorted(self.hot_ids):
+            aname, spec, off = self.resolve_table(tname)
+            hot = jnp.take(
+                tables[aname], jnp.asarray(self.hot_ids[tname]) + off, axis=0)
+            if self.mesh is not None:
+                hot = jax.device_put(hot, NamedSharding(self.mesh, P()))
+            tables[self.hot_array_name(tname)] = hot
         return tables
 
     # -------------------------------------------------------------- lookup
@@ -385,6 +526,10 @@ class ShardedEmbeddingCollection:
         tname = self._feature_to_table.get(feature)
         if tname is None:
             raise KeyError(f"no table serves feature {feature!r}")
+        return self.resolve_table(tname)
+
+    def resolve_table(self, tname: str) -> tuple[str, EmbeddingSpec, int]:
+        """:meth:`resolve` keyed by logical TABLE name instead of feature."""
         spec = self.specs[tname]
         if spec.sharding == "table":
             offset, _ = self._stack_rows[tname]
@@ -511,10 +656,7 @@ class ShardedEmbeddingCollection:
             def local(ids_local, rows_per_shard=rows_per_shard, offset=offset):
                 flat = ids_local.reshape(-1) + offset
                 n = flat.shape[0]
-                # mirror _lookup_alltoall's capacity arithmetic exactly
-                cap = min(n, max(1, int(cf * n / m)))
-                if cap < n:
-                    cap = min(n, -(-cap // 8) * 8)
+                cap = _a2a_bucket_cap(n, m, cf)
                 owner = jnp.clip(flat // rows_per_shard, 0, m - 1)
                 counts = jnp.sum(
                     (owner[None, :] == jnp.arange(m)[:, None]), axis=1
@@ -540,6 +682,9 @@ class ShardedEmbeddingCollection:
         gains a trailing ``embedding_dim`` axis."""
         out: dict[str, jax.Array] = {}
         for feat, ids in features.items():
+            if self._feature_to_table.get(feat) in self.hot_ids:
+                out[feat] = self._lookup_hotcold(tables, feat, ids, mode)
+                continue
             tname, spec, offset = self.resolve(feat)
             table = tables[tname]
             if mode == "gspmd" or self.mesh is None or spec.sharding in ("replicated",):
@@ -577,6 +722,28 @@ class ShardedEmbeddingCollection:
                 raise ValueError(f"unknown lookup mode {mode!r}")
             out[feat] = vecs
         return out
+
+    def _lookup_hotcold(self, tables, feat: str, ids: jax.Array, mode: str):
+        """Routed lookup for a hot/cold table: gather both sides (row
+        gathers are cheap on v5e, ~60-90 us for 8192 x 64), select per
+        position.  Fully-hot tables skip the cold gather statically.  The
+        dedup-lookup train step re-implements the cold half over its shared
+        sort; this method is the plain-forward/eval path."""
+        if mode != "gspmd":
+            raise ValueError(
+                f"hot/cold tables compose with lookup mode 'gspmd' only, "
+                f"got {mode!r} for feature {feat!r}")
+        tname = self._feature_to_table[feat]
+        hot_pos, cold_ids = self.route_ids(feat, ids)
+        hot = tables[self.hot_array_name(tname)]
+        hot_vec = jnp.take(hot, jnp.maximum(hot_pos, 0), axis=0)
+        if self._hot_full[tname]:
+            return hot_vec  # padding ids clamp to hot row 0 (clip parity)
+        aname, spec, offset = self.resolve(feat)
+        cold_vec = jnp.take(
+            tables[aname], jnp.where(cold_ids >= 0, cold_ids + offset, 0),
+            axis=0)
+        return jnp.where((hot_pos >= 0)[..., None], hot_vec, cold_vec)
 
     def _local_gather(self, spec: EmbeddingSpec):
         """(table_shard, vocab-row idx) -> [.., d] gather for the explicit
@@ -657,11 +824,7 @@ class ShardedEmbeddingCollection:
 
         def local(table_shard, ids_local):
             n = ids_local.shape[0]  # local batch
-            # bucket capacity: worst case n (exact for any skew) unless a
-            # capacity factor bounds it to cf x the balanced share
-            cap = n if cf is None else min(n, max(1, int(cf * n / m)))
-            if cap < n:  # sublane-friendly, never past the exact worst case
-                cap = min(n, -(-cap // 8) * 8)
+            cap = _a2a_bucket_cap(n, m, cf)
             owner = jnp.clip(ids_local // rows_per_shard, 0, m - 1)  # [n]
             iota = jnp.arange(n, dtype=jnp.int32)
             # ONE payload-carrying sort by owner -> contiguous buckets AND the

@@ -71,6 +71,7 @@ class CheckpointManager:
         state: Any,
         *,
         cursor: dict[str, Any] | None = None,
+        stamps: dict[str, Any] | None = None,
         force: bool = False,
     ) -> None:
         """Write the state pytree (and an optional data-stream ``cursor``)
@@ -79,7 +80,14 @@ class CheckpointManager:
         written by process 0 only, AFTER the orbax write is durable, so a
         cursor file on disk always refers to a complete checkpoint.  Saves
         retry with backoff (``tdfo_tpu/utils/retry.py``): transient storage
-        failures must not kill an otherwise-healthy run."""
+        failures must not kill an otherwise-healthy run.
+
+        ``stamps``: JSON-able compatibility fingerprints beyond the layout
+        version (e.g. the hot/cold mode's per-table hot-id digests — same
+        shapes under a DIFFERENT hot set would restore cleanly but pair
+        every hot row with the wrong id).  Written as a
+        ``stamps_<step_id>.json`` sidecar and VERIFIED on restore: a
+        mismatch (or a missing side) refuses the resume."""
         payload = {
             "layout_version": np.asarray(LAYOUT_VERSION, np.int32),
             "state": state,
@@ -102,6 +110,15 @@ class CheckpointManager:
                 )
             elif cpath.exists():
                 cpath.unlink()  # force-overwrite must not keep a stale cursor
+            spath = self._stamps_path(step_id)
+            if stamps:
+                retry_call(
+                    spath.write_text,
+                    json.dumps(stamps),
+                    description=f"stamps_save:{step_id}",
+                )
+            elif spath.exists():
+                spath.unlink()
             self._prune_cursors()
 
     def latest_step(self) -> int | None:
@@ -110,11 +127,15 @@ class CheckpointManager:
     def _cursor_path(self, step_id: int) -> Path:
         return self._dir / f"cursor_{step_id}.json"
 
+    def _stamps_path(self, step_id: int) -> Path:
+        return self._dir / f"stamps_{step_id}.json"
+
     def _prune_cursors(self) -> None:
-        """Drop cursor sidecars whose checkpoint was garbage-collected by
-        ``max_to_keep`` so the directory never accumulates orphans."""
+        """Drop cursor/stamps sidecars whose checkpoint was garbage-collected
+        by ``max_to_keep`` so the directory never accumulates orphans."""
         live = set(self._mgr.all_steps())
-        for p in self._dir.glob("cursor_*.json"):
+        for p in (*self._dir.glob("cursor_*.json"),
+                  *self._dir.glob("stamps_*.json")):
             try:
                 step = int(p.stem.split("_", 1)[1])
             except ValueError:
@@ -130,17 +151,34 @@ class CheckpointManager:
             return None
         return json.loads(cpath.read_text())
 
-    def restore(self, state_like: Any, step_id: int | None = None):
+    def restore(self, state_like: Any, step_id: int | None = None, *,
+                stamps: dict[str, Any] | None = None):
         """Restore into the structure/shardings of ``state_like``.  Returns
         ``(step_id, state, cursor)`` or ``None`` when no checkpoint exists;
         ``cursor`` is the data-stream position saved alongside (None for
         legacy epoch-indexed checkpoints).  Refuses checkpoints whose
         storage-layout version differs from :data:`LAYOUT_VERSION` (same
         shapes, different value layout — a silent-corruption hazard, e.g. the
-        round-4 fused-QKV reorder or the round-5 fat-line packing)."""
+        round-4 fused-QKV reorder or the round-5 fat-line packing), and
+        checkpoints whose ``stamps`` sidecar does not match the caller's
+        ``stamps`` (e.g. a hot/cold run resumed under a different hot-id
+        set: identical shapes, every hot row paired with the wrong id)."""
         step_id = self._mgr.latest_step() if step_id is None else step_id
         if step_id is None:
             return None
+        spath = self._stamps_path(step_id)
+        saved_stamps = json.loads(spath.read_text()) if spath.exists() else {}
+        if (stamps or {}) != saved_stamps:
+            raise ValueError(
+                f"checkpoint step {step_id} in {self._dir} was saved with "
+                f"compatibility stamps {saved_stamps!r}, but this run "
+                f"expects {(stamps or {})!r}.  The state trees may restore "
+                "cleanly anyway (identical shapes) with values paired to "
+                "the WRONG ids — e.g. a hot/cold embedding run resumed "
+                "under a different hot-id set — so resuming is refused.  "
+                "Re-run with the matching artifacts (same data_dir "
+                "hot_ids.json), or retrain."
+            )
         # probe the SAVED tree's metadata for the stamp before restoring:
         # a missing stamp is the legacy (pre-versioning) format and must be
         # refused — without conflating genuine I/O or sharding errors from
@@ -165,12 +203,32 @@ class CheckpointManager:
             "layout_version": jax.ShapeDtypeStruct((), np.int32),
             "state": jax.tree.map(ocp.utils.to_shape_dtype_struct, state_like),
         }
-        restored = retry_call(
-            self._mgr.restore,
-            step_id,
-            args=ocp.args.StandardRestore(abstract),
-            description=f"ckpt_restore:{step_id}",
-        )
+        try:
+            restored = retry_call(
+                self._mgr.restore,
+                step_id,
+                args=ocp.args.StandardRestore(abstract),
+                description=f"ckpt_restore:{step_id}",
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            if meta_tree is not None:
+                raise
+            # the metadata probe failed (meta is None), so the legacy-format
+            # refusal above could not fire — a pre-versioning checkpoint then
+            # surfaces here as an opaque orbax structure mismatch (the
+            # abstract tree expects a layout_version leaf the legacy save
+            # never wrote).  Re-raise with the layout-version guidance
+            # appended so the operator sees the real cause.
+            raise ValueError(
+                f"restoring checkpoint step {step_id} in {self._dir} failed "
+                f"with: {e}.  Its metadata could not be probed, which "
+                "together with this structure mismatch usually means the "
+                "checkpoint predates the layout_version stamp "
+                "(tdfo_tpu/train/checkpoint.py LAYOUT_VERSION).  Parameter "
+                "LAYOUT changes restore without shape errors but scramble "
+                "values, so unstamped checkpoints cannot be resumed.  "
+                "Retrain, or convert the checkpoint offline."
+            ) from e
         found = int(np.asarray(restored["layout_version"]))
         if found != LAYOUT_VERSION:
             raise ValueError(

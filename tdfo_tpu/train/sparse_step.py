@@ -99,6 +99,16 @@ def make_sparse_train_step(
     measured ~25%% off the DLRM-Criteo step.  Arrays whose update needs the
     explicit shard_map program (fused fat + real row sharding) keep the
     default update path.
+
+    Hot/cold collections (``ShardedEmbeddingCollection`` built with
+    ``hot_ids``, requires ``mode="gspmd"``): each split table's ids route
+    once per step into hot-head positions and residual cold ids.  The hot
+    half updates via ONE one-hot MXU contraction + dense [K, D]
+    read-modify-write per table (``SparseOptimizer.dense_update`` — no
+    sort/dedupe/scatter for the power-law head, where most ids land); the
+    cold half rides the unchanged machinery above with hot hits as -1
+    (dropped by dedupe like padding).  Fully-hot tables skip the cold side
+    statically, shrinking the cold distinct-row bound and scatter cost.
     """
     import inspect
 
@@ -106,23 +116,50 @@ def make_sparse_train_step(
         raise ValueError("dedup_lookup composes with lookup mode 'gspmd' only")
     features = list(coll.features())
     takes_rng = "dropout_rng" in inspect.signature(forward).parameters
+    # hot/cold (frequency-partitioned) tables: per-feature id routing splits
+    # lookups into hot-head positions (updated scatter-free via one-hot MXU
+    # contractions, no dedupe) and residual cold ids (riding the unchanged
+    # machinery below — hot hits become -1 and the existing negative-id
+    # padding semantics drop them everywhere).  All statics resolved here.
+    hot_tables = coll.hot_tables()
+    if hot_tables and mode != "gspmd":
+        raise ValueError(
+            "hot/cold tables compose with lookup mode 'gspmd' only")
+    feat_table = {f: coll.resolve(f)[1].name for f in features}
+    hot_by_table = {
+        t: [f for f in features if feat_table[f] == t] for t in hot_tables
+    }
+    hot_feats = {f for t in hot_tables for f in hot_by_table[t]}
+    # features of FULLY hot tables have no cold side at all: they skip the
+    # cold concat/dedupe/gather/update statically (at the Criteo profile 18
+    # of 26 tables fit under a 16k hot cap, shrinking the cold distinct-row
+    # bound ~102k -> ~65k and the scatter cost with it)
+    full_hot_feats = {f for f in hot_feats if coll.hot_full(feat_table[f])}
     by_table_static: dict[str, list[str]] = {}
     for f in features:
+        if f in full_hot_feats:
+            continue
         by_table_static.setdefault(coll.resolve(f)[0], []).append(f)
 
     def _concat_ids(feats, ids, rows_per_line: int = 1):
         id_list, sizes, bound = [], [], 0
         for f in feats:
             _, spec, offset = coll.resolve(f)
-            flat = (ids[f] + offset).reshape(-1)
+            # negative (padding or routed-to-hot) ids must stay negative:
+            # adding the stack offset would alias them into the previous
+            # member's rows and corrupt its update
+            flat = jnp.where(ids[f] >= 0, ids[f] + offset, -1).reshape(-1)
             id_list.append(flat)
             sizes.append(flat.shape[0])
             # static per-feature distinct bound: a feature can touch at most
-            # min(its id count, its member vocab) rows — or, for fat-line
-            # arrays, that many LINES (+1: a member's row range may straddle
-            # one extra line at each unaligned stack offset)
+            # min(its id count, its member vocab) rows — minus the hot-head
+            # rows for hot/cold tables (hot ids never reach the cold side) —
+            # or, for fat-line arrays, that many LINES (+1: a member's row
+            # range may straddle one extra line at each unaligned stack
+            # offset)
             if rows_per_line == 1:
-                bound += min(flat.shape[0], spec.num_embeddings)
+                cold_rows = spec.num_embeddings - coll.hot_count(spec.name)
+                bound += min(flat.shape[0], cold_rows)
             else:
                 bound += min(flat.shape[0],
                              -(-spec.num_embeddings // rows_per_line) + 1)
@@ -135,6 +172,30 @@ def make_sparse_train_step(
         step_rng = None
         if takes_rng and rng is not None:
             step_rng = jax.random.fold_in(rng, state.step)
+
+        # hot/cold routing: one remap per hot feature, shared by the
+        # forward gather and both update halves.  cold_ids carries -1 at
+        # hot hits (dropped by dedupe / clamped by gathers), hot_pos
+        # carries -1 at cold hits (zeroed by the one-hot contraction).
+        hot_pos: dict[str, jax.Array] = {}
+        cold_ids = ids
+        if hot_tables:
+            cold_ids = dict(ids)
+            for f in hot_feats:
+                hp, ci = coll.route_ids(f, ids[f])
+                hot_pos[f] = hp
+                cold_ids[f] = ci
+
+        def _merge_hot(f, cold_vec):
+            """Select hot-head vectors at hot hits (identity off hot/cold)."""
+            hp = hot_pos.get(f)
+            if hp is None:
+                return cold_vec
+            hot = state.tables[coll.hot_array_name(feat_table[f])]
+            hot_vec = jnp.take(hot, jnp.maximum(hp, 0), axis=0)
+            if cold_vec is None:  # fully hot: there is no cold side
+                return hot_vec
+            return jnp.where((hp >= 0)[..., None], hot_vec, cold_vec)
 
         # Gradients w.r.t. the gathered vectors, never the [V, D] table.
         def loss_from_embs(dense_params, embs):
@@ -158,7 +219,7 @@ def make_sparse_train_step(
                 table = state.tables[tname]
                 d = coll.array_embedding_dim(tname)
                 fat = table.ndim == 3
-                all_ids, sizes, bound = _concat_ids(feats, ids)
+                all_ids, sizes, bound = _concat_ids(feats, cold_ids)
                 total = all_ids.shape[0]
                 # +1 slack: negative (padding) ids dedupe to ONE sentinel
                 # slot beyond the real-id bound; without it the expand would
@@ -176,7 +237,7 @@ def make_sparse_train_step(
                     from tdfo_tpu.ops.sparse import dedupe_rows_and_lines
 
                     lay = coll.fat_layout_for(tname)
-                    _, _, bound_l = _concat_ids(feats, ids,
+                    _, _, bound_l = _concat_ids(feats, cold_ids,
                                                 rows_per_line=lay.r)
                     cap_r = cap if cap is not None else total
                     cap_l = min(cap_r, -(-(bound_l + 1) // 8) * 8)
@@ -207,9 +268,13 @@ def make_sparse_train_step(
                 off = 0
                 for f, n_f in zip(feats, sizes):
                     e = jnp.take(rows, seg[off:off + n_f], axis=0)
-                    embs[f] = e.reshape(*ids[f].shape, e.shape[-1])
+                    e = e.reshape(*ids[f].shape, e.shape[-1])
+                    embs[f] = _merge_hot(f, e)
                     off += n_f
+            for f in full_hot_feats:  # no cold side: hot gather only
+                embs[f] = _merge_hot(f, None)
         else:
+            # coll.lookup routes hot/cold internally (eval shares that path)
             embs = coll.lookup(state.tables, ids, mode=mode)
         loss, (g_dense, g_embs) = jax.value_and_grad(
             loss_from_embs, argnums=(0, 1), has_aux=with_aux
@@ -271,7 +336,7 @@ def make_sparse_train_step(
                     embedding_dim=d_t,
                 )
                 continue
-            all_ids, _, bound = _concat_ids(feats, ids)
+            all_ids, _, bound = _concat_ids(feats, cold_ids)
             # dedupe capacity = the proven bound when it is tighter than the
             # id count: scatter cost scales with SLOTS, so stacked many-table
             # arrays (e.g. DLRM-Criteo, where small tables are fully covered
@@ -284,6 +349,23 @@ def make_sparse_train_step(
                 state.sparse_opt, tname,
                 state.tables[tname], state.slots[tname], all_ids, all_grads,
                 max_distinct=md,
+            )
+
+        # hot-head updates: per logical table, ONE one-hot MXU contraction
+        # merges duplicates and a full dense [K, D] read-modify-write
+        # applies the optimizer — no sort, no dedupe, no scatter (the
+        # power-law head is where scatters hurt: most of the batch's ids
+        # land here).  Cold hits carry hot_pos -1 and one-hot to zero rows.
+        for tname in hot_tables:
+            hname = coll.hot_array_name(tname)
+            feats = hot_by_table[tname]
+            hp_all = jnp.concatenate(
+                [hot_pos[f].reshape(-1) for f in feats])
+            g_all = jnp.concatenate([
+                g_embs[f].reshape(-1, g_embs[f].shape[-1]) for f in feats
+            ])
+            new_tables[hname], new_slots[hname] = state.sparse_opt.dense_update(
+                state.tables[hname], state.slots[hname], hp_all, g_all,
             )
 
         return (

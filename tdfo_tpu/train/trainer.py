@@ -283,6 +283,7 @@ class Trainer:
         self.logger = MetricLogger(log_dir or config.checkpoint_dir,
                                    tensorboard=config.tensorboard)
         self._ckpt = None
+        self._ckpt_stamps = None  # compatibility stamps (hot/cold digests)
         self._logged_steps = 0  # run-global data-step counter (batches consumed)
         self._a2a_overflow = None  # alltoall dropped-id diagnostic (jitted)
         self._map_streams: dict = {}  # streaming=false table cache
@@ -442,12 +443,35 @@ class Trainer:
             specs = ctr_embedding_specs(
                 cfg.size_map, cfg.embed_dim, sharding,
                 fused_threshold=cfg.effective_fused_threshold)
+        hot_ids = None
+        if cfg.embeddings.hot_vocab > 0:
+            from tdfo_tpu.data.hot_ids import load_hot_ids
+
+            artifact = load_hot_ids(cfg.data_dir)
+            if artifact is None:
+                raise ValueError(
+                    "embeddings.hot_vocab > 0 but no hot_ids.json under "
+                    f"{cfg.data_dir!r} — re-run preprocessing with this "
+                    "config to emit the hot/cold remap artifact"
+                )
+            # the artifact keys by feature/column name; keep only tables this
+            # model actually serves (a schema subset is fine, the rest of the
+            # artifact is simply unused)
+            served = {f for s in specs for f in s.features} | {s.name for s in specs}
+            hot_ids = {k: v for k, v in artifact.items() if k in served} or None
         coll = ShardedEmbeddingCollection(
             specs,
             mesh=self.mesh,
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
             stack_tables=cfg.stack_tables,
             fused_kind=cfg.sparse_optimizer,
+            hot_ids=hot_ids,
+        )
+        # hot/cold checkpoints are only loadable under the SAME hot sets —
+        # stamp the digests into the checkpoint sidecar so a mismatched
+        # restore refuses instead of silently mis-routing rows
+        self._ckpt_stamps = (
+            {"hot_ids": coll.hot_digest()} if coll.hot_ids else None
         )
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)
@@ -893,6 +917,7 @@ class Trainer:
                                 "epoch_complete": False, "global_step": gstep,
                                 "loss_sum": loss_sum,
                                 "contributed": contributed},
+                        stamps=self._ckpt_stamps,
                     )
                     next_ckpt = (n_steps // ckpt_n + 1) * ckpt_n
                 if inj is not None:
@@ -1080,7 +1105,8 @@ class Trainer:
         start_epoch = 0
         resume = {"step": 0, "loss_sum": 0.0, "contributed": 0}
         if self._ckpt is not None:
-            restored = self._ckpt.restore(self.state)
+            restored = self._ckpt.restore(self.state,
+                                          stamps=self._ckpt_stamps)
             if restored is not None:
                 step_id, self.state, cursor = restored
                 if cursor is None:
@@ -1125,6 +1151,7 @@ class Trainer:
                         gstep, self.state, force=True,
                         cursor={"epoch": epoch, "step": 0,
                                 "epoch_complete": True, "global_step": gstep},
+                        stamps=self._ckpt_stamps,
                     )
             # final held-out test evaluation (bert4rec; no-op elsewhere)
             metrics.update(self.evaluate_test())

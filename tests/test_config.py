@@ -135,6 +135,53 @@ def test_fault_tolerance_knob_validation():
            faults=FaultSpec(fail_io_nth=2))
 
 
+def test_fused_threshold_disable_semantics(tmp_path: Path):
+    """-1 is the explicit opt-out: effective_fused_threshold becomes None so
+    NO table fuses (the old magic 100000000 relied on no vocab exceeding
+    it).  0 still means "fuse everything"; other negatives are rejected."""
+    assert Config(fused_table_threshold=-1).effective_fused_threshold is None
+    assert Config(fused_table_threshold=0).effective_fused_threshold == 0
+    assert Config().effective_fused_threshold == 16384
+    with pytest.raises(ValueError, match="fused_table_threshold"):
+        Config(fused_table_threshold=-2)
+    (tmp_path / "config.toml").write_text("fused_table_threshold = -1\n")
+    assert read_configs(tmp_path / "config.toml").effective_fused_threshold is None
+    # the observable semantic: -1 yields NO fused spec even for huge vocabs
+    from tdfo_tpu.models.dlrm import generic_embedding_specs
+
+    specs = generic_embedding_specs(
+        {"c": 10**9}, ("c",), 16, "row",
+        fused_threshold=Config(fused_table_threshold=-1).effective_fused_threshold)
+    assert not specs[0].fused
+
+
+def test_embeddings_table(tmp_path: Path):
+    """The [embeddings] section maps onto EmbeddingsSpec; unknown keys and
+    invalid values fail loudly like every other config key."""
+    (tmp_path / "config.toml").write_text(
+        "[embeddings]\nhot_vocab = 4096\nhot_fraction = 0.8\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.embeddings.hot_vocab == 4096
+    assert cfg.embeddings.hot_fraction == 0.8
+    # defaults: hot/cold disabled
+    assert read_configs().embeddings.hot_vocab == 0
+    (tmp_path / "config.toml").write_text("[embeddings]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="bogus"):
+        read_configs(tmp_path / "config.toml")
+
+
+def test_embeddings_knob_validation():
+    from tdfo_tpu.core.config import EmbeddingsSpec
+
+    with pytest.raises(ValueError, match="hot_vocab"):
+        Config(embeddings=EmbeddingsSpec(hot_vocab=-1))
+    with pytest.raises(ValueError, match="hot_fraction"):
+        Config(embeddings=EmbeddingsSpec(hot_vocab=8, hot_fraction=0.0))
+    with pytest.raises(ValueError, match="gspmd"):
+        Config(embeddings=EmbeddingsSpec(hot_vocab=8), lookup_mode="psum")
+    Config(embeddings=EmbeddingsSpec(hot_vocab=8, hot_fraction=1.0))
+
+
 def test_bert4rec_rejects_tfrecord():
     """write_format must DO something for every model: the seq ETL writes
     list-valued columns tfrecord does not carry (VERDICT r3 weak #4)."""

@@ -1,0 +1,400 @@
+"""Frequency-partitioned hot/cold embedding tests.
+
+The hot/cold mode must be a pure LAYOUT optimisation: same-seed runs with
+and without the split produce the same trajectory (losses and effective
+tables) for every optimizer kind, across routing flavours (contiguous
+prefix, scattered set, fully hot) and both forward paths (dedup_lookup
+on/off).  The artifact pipeline (counts -> hot_ids.json -> collection ->
+checkpoint stamps) is covered end to end.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tdfo_tpu.data.hot_ids import (
+    hot_ids_from_counts,
+    hot_ids_digest,
+    load_hot_ids,
+    write_hot_ids,
+)
+from tdfo_tpu.ops.sparse import (
+    dedupe_grads,
+    sparse_adagrad,
+    sparse_adam,
+    sparse_optimizer,
+    sparse_rowwise_adagrad,
+    sparse_sgd,
+)
+from tdfo_tpu.parallel.embedding import EmbeddingSpec, ShardedEmbeddingCollection
+
+# --------------------------------------------------------------- artifacts
+
+
+def test_hot_ids_from_counts_coverage_cut():
+    # 80% of mass on id 3, the rest uniform: hot_fraction=0.5 takes just it
+    counts = np.array([1, 1, 1, 12, 1], np.int64)
+    ids = hot_ids_from_counts(counts, hot_vocab=4, hot_fraction=0.5)
+    np.testing.assert_array_equal(ids, [3])
+    # raising the fraction pulls in more ids (ties break toward lower ids)
+    ids = hot_ids_from_counts(counts, hot_vocab=4, hot_fraction=0.85)
+    np.testing.assert_array_equal(ids, [0, 1, 3])
+
+
+def test_hot_ids_from_counts_cap_binds():
+    counts = np.ones(100, np.int64)  # uniform: coverage wants all of them
+    ids = hot_ids_from_counts(counts, hot_vocab=8, hot_fraction=0.99)
+    assert ids.shape == (8,)
+    assert np.all(np.diff(ids) > 0)
+
+
+def test_hot_ids_from_counts_small_vocab_fully_hot():
+    ids = hot_ids_from_counts(np.array([5, 0, 1]), hot_vocab=16,
+                              hot_fraction=0.1)
+    np.testing.assert_array_equal(ids, [0, 1, 2])
+
+
+def test_hot_ids_from_counts_rejects_bad_cap():
+    with pytest.raises(ValueError, match="hot_vocab"):
+        hot_ids_from_counts(np.ones(4), hot_vocab=0)
+
+
+def test_artifact_roundtrip_and_digest(tmp_path):
+    per = {"c0": np.array([0, 1, 2], np.int32),
+           "c1": np.array([3, 9, 11], np.int32)}
+    write_hot_ids(tmp_path, per, hot_vocab=16, hot_fraction=0.9,
+                  coverage={"c0": 1.0, "c1": 0.93})
+    loaded = load_hot_ids(tmp_path)
+    assert set(loaded) == {"c0", "c1"}
+    for k in per:
+        np.testing.assert_array_equal(loaded[k], per[k])
+        assert loaded[k].dtype == np.int32
+    # digest is stable through the round trip and sensitive to the id set
+    assert hot_ids_digest(loaded) == hot_ids_digest(per)
+    changed = dict(per, c1=np.array([3, 9, 12], np.int32))
+    assert hot_ids_digest(changed)["c1"] != hot_ids_digest(per)["c1"]
+    assert hot_ids_digest(changed)["c0"] == hot_ids_digest(per)["c0"]
+
+
+def test_artifact_absent_and_corrupt(tmp_path):
+    assert load_hot_ids(tmp_path) is None
+    write_hot_ids(tmp_path, {"c0": np.array([2, 1])}, hot_vocab=4,
+                  hot_fraction=0.9)  # unsorted: corrupt on read
+    with pytest.raises(ValueError, match="sorted"):
+        load_hot_ids(tmp_path)
+    import json
+    p = tmp_path / "hot_ids.json"
+    payload = json.loads(p.read_text())
+    payload["tables"] = {"c0": [1, 2]}
+    payload["format_version"] = 99
+    p.write_text(json.dumps(payload))
+    with pytest.raises(ValueError, match="format_version"):
+        load_hot_ids(tmp_path)
+
+
+def test_criteo_preprocessing_emits_artifact(tmp_path):
+    from tdfo_tpu.data.criteo_preprocessing import (
+        CRITEO_CATEGORICAL,
+        run_criteo_preprocessing,
+    )
+    from tdfo_tpu.data.synthetic import write_synthetic_criteo
+
+    write_synthetic_criteo(tmp_path, n_rows=600, seed=0)
+    size_map = run_criteo_preprocessing(tmp_path, hot_vocab=8,
+                                        hot_fraction=0.8, min_freq=2)
+    loaded = load_hot_ids(tmp_path)
+    assert loaded is not None and set(loaded) == set(CRITEO_CATEGORICAL)
+    for c in CRITEO_CATEGORICAL:
+        ids = loaded[c]
+        assert 1 <= ids.shape[0] <= max(8, size_map[c])
+        assert ids.shape[0] <= size_map[c]
+        assert np.all(ids >= 0) and np.all(ids < size_map[c])
+        assert np.all(np.diff(ids) > 0)
+    import json
+    payload = json.loads((tmp_path / "hot_ids.json").read_text())
+    cov = payload["coverage"]
+    assert all(0.0 < cov[c] <= 1.0 + 1e-9 for c in CRITEO_CATEGORICAL)
+
+
+# ---------------------------------------------------------------- routing
+
+
+def _routed_coll():
+    specs = [
+        EmbeddingSpec("prefix", 10, 8, features=("prefix",)),
+        EmbeddingSpec("scatter", 10, 8, features=("scatter",)),
+        EmbeddingSpec("full", 5, 8, features=("full",)),
+    ]
+    hot = {
+        "prefix": np.arange(4, dtype=np.int32),
+        "scatter": np.array([1, 3, 7], np.int32),
+        "full": np.arange(5, dtype=np.int32),
+    }
+    return ShardedEmbeddingCollection(specs, hot_ids=hot)
+
+
+def test_route_ids_prefix_scatter_full():
+    coll = _routed_coll()
+    assert coll._hot_prefix["prefix"] and not coll._hot_full["prefix"]
+    assert not coll._hot_prefix["scatter"]
+    assert coll.hot_full("full") and coll.hot_count("full") == 5
+
+    ids = jnp.asarray([0, 3, 4, 9, -1], jnp.int32)
+    hp, ci = coll.route_ids("prefix", ids)
+    np.testing.assert_array_equal(np.asarray(hp), [0, 3, -1, -1, -1])
+    np.testing.assert_array_equal(np.asarray(ci), [-1, -1, 4, 9, -1])
+
+    ids = jnp.asarray([1, 3, 7, 0, 2, 9, -1], jnp.int32)
+    hp, ci = coll.route_ids("scatter", ids)
+    np.testing.assert_array_equal(np.asarray(hp), [0, 1, 2, -1, -1, -1, -1])
+    np.testing.assert_array_equal(np.asarray(ci), [-1, -1, -1, 0, 2, 9, -1])
+
+    ids = jnp.asarray([4, 0, -1], jnp.int32)
+    hp, ci = coll.route_ids("full", ids)
+    np.testing.assert_array_equal(np.asarray(hp), [4, 0, -1])
+    np.testing.assert_array_equal(np.asarray(ci), [-1, -1, -1])
+
+    # unsplit table: identity routing
+    coll2 = ShardedEmbeddingCollection(
+        [EmbeddingSpec("a", 10, 8, features=("a",))])
+    hp, ci = coll2.route_ids("a", ids)
+    assert hp is None
+    np.testing.assert_array_equal(np.asarray(ci), np.asarray(ids))
+
+
+def test_hot_ids_validation():
+    spec = [EmbeddingSpec("a", 10, 8, features=("a",))]
+    with pytest.raises(KeyError, match="neither a table nor a feature"):
+        ShardedEmbeddingCollection(spec, hot_ids={"nope": np.arange(2)})
+    with pytest.raises(ValueError, match="sorted"):
+        ShardedEmbeddingCollection(spec, hot_ids={"a": np.array([2, 1])})
+    with pytest.raises(ValueError, match="outside"):
+        ShardedEmbeddingCollection(spec, hot_ids={"a": np.array([8, 10])})
+    fused = [EmbeddingSpec("a", 10, 8, features=("a",), fused=True)]
+    with pytest.raises(ValueError, match="non-fused"):
+        ShardedEmbeddingCollection(fused, hot_ids={"a": np.arange(2)})
+
+
+def test_hot_lookup_matches_plain(mesh8):
+    """Routed lookup (prefix, scattered and fully hot tables) returns the
+    same vectors as the same-seed unsplit collection."""
+    specs = lambda: [
+        EmbeddingSpec("prefix", 10, 8, features=("prefix",), sharding="row"),
+        EmbeddingSpec("scatter", 10, 8, features=("scatter",), sharding="row"),
+        EmbeddingSpec("full", 5, 8, features=("full",), sharding="row"),
+    ]
+    hot = {
+        "prefix": np.arange(4, dtype=np.int32),
+        "scatter": np.array([1, 3, 7], np.int32),
+        "full": np.arange(5, dtype=np.int32),
+    }
+    base = ShardedEmbeddingCollection(specs(), mesh=mesh8)
+    split = ShardedEmbeddingCollection(specs(), mesh=mesh8, hot_ids=hot)
+    t_base = base.init(jax.random.key(0))
+    t_split = split.init(jax.random.key(0))
+    ids = {
+        "prefix": jnp.asarray([0, 3, 4, 9], jnp.int32),
+        "scatter": jnp.asarray([1, 0, 7, 9], jnp.int32),
+        "full": jnp.asarray([4, 0, 2, 1], jnp.int32),
+    }
+    out_b = base.lookup(t_base, ids)
+    out_s = split.lookup(t_split, ids)
+    for f in ids:
+        np.testing.assert_allclose(np.asarray(out_s[f]),
+                                   np.asarray(out_b[f]), rtol=1e-6)
+
+
+# ------------------------------------------------- dense lazy tier parity
+
+
+def _ref_update(kind, table, slots, ids, grads, lr=1e-2, wd=1e-3):
+    """Reference: dedupe + the sparse_* row functions (the cold path)."""
+    cap = ids.shape[0] + 1
+    uids, g, valid = dedupe_grads(ids, grads, capacity=cap,
+                                  vocab=table.shape[0] + 1)
+    if kind == "sgd":
+        return sparse_sgd(table, uids, g, valid, lr=lr, weight_decay=wd), ()
+    if kind == "adagrad":
+        t, a = sparse_adagrad(table, slots[0], uids, g, valid, lr=lr,
+                              weight_decay=wd)
+        return t, (a,)
+    if kind == "rowwise_adagrad":
+        t, a = sparse_rowwise_adagrad(table, slots[0], uids, g, valid, lr=lr,
+                                      weight_decay=wd)
+        return t, (a,)
+    t, m, n, c = sparse_adam(table, *slots, uids, g, valid, lr=lr,
+                             weight_decay=wd)
+    return t, (m, n, c)
+
+
+@pytest.mark.parametrize("kind", ["sgd", "adagrad", "rowwise_adagrad", "adam"])
+def test_dense_update_matches_sparse_reference(kind):
+    """dense_update (one-hot MXU + masked RMW) must equal the dedupe +
+    gather/scatter formulation row for row — duplicates merged, negative
+    (routed-away) ids ignored, untouched rows bit-untouched."""
+    rng = np.random.default_rng(3)
+    v, d, b = 12, 8, 20
+    table = jnp.asarray(rng.normal(size=(v, d)).astype(np.float32))
+    ids_np = rng.integers(0, v, b).astype(np.int32)
+    ids_np[::5] = -1  # padding / routed-to-other-half entries
+    ids = jnp.asarray(ids_np)
+    grads = jnp.asarray(rng.normal(size=(b, d)).astype(np.float32))
+
+    opt = sparse_optimizer(kind, lr=1e-2, weight_decay=1e-3)
+    slots = opt.init(table)
+    new_t, new_s = jax.jit(opt.dense_update)(table, slots, ids, grads)
+    ref_t, ref_s = _ref_update(kind, table, slots, ids, grads)
+
+    np.testing.assert_allclose(np.asarray(new_t), np.asarray(ref_t),
+                               rtol=1e-5, atol=1e-6)
+    for a, b_ in zip(new_s, ref_s):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-6)
+    # untouched rows are IDENTICAL (lazy state: no decay, no wd)
+    untouched = np.setdiff1d(np.arange(v), ids_np[ids_np >= 0])
+    np.testing.assert_array_equal(np.asarray(new_t)[untouched],
+                                  np.asarray(table)[untouched])
+
+
+def test_dense_update_rejects_fat_tables():
+    opt = sparse_optimizer("sgd", lr=1e-2)
+    fat = jnp.zeros((4, 2, 128), jnp.float32)
+    with pytest.raises(ValueError, match="2D"):
+        opt.dense_update(fat, (), jnp.zeros((2,), jnp.int32),
+                         jnp.zeros((2, 8), jnp.float32))
+
+
+# ------------------------------------------- end-to-end trajectory parity
+
+
+CATS = ("c0", "c1", "c2")
+CONTS = ("x0",)
+SIZES = {"c0": 7, "c1": 50, "c2": 300}
+# c0 fully hot, c1 a contiguous prefix, c2 a genuine scattered set — the
+# three routing flavours in one model
+HOT = {
+    "c0": np.arange(7, dtype=np.int32),
+    "c1": np.arange(8, dtype=np.int32),
+    "c2": np.sort(np.random.default_rng(5).choice(
+        300, size=12, replace=False)).astype(np.int32),
+}
+
+
+def _run_trajectory(mesh, kind, dedup, hot):
+    from tdfo_tpu.models.dlrm import DLRMBackbone, generic_embedding_specs
+    from tdfo_tpu.train.ctr import ctr_sparse_forward
+    from tdfo_tpu.train.sparse_step import (
+        SparseTrainState,
+        make_sparse_train_step,
+    )
+
+    coll = ShardedEmbeddingCollection(
+        generic_embedding_specs(SIZES, CATS, 8, "row", fused_threshold=None),
+        mesh=mesh, stack_tables=True, hot_ids=hot,
+    )
+    bb = DLRMBackbone(embed_dim=8, cat_columns=CATS, cont_columns=CONTS)
+    tables = coll.init(jax.random.key(0))
+    dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in CATS}
+    dummy_c = {c: jnp.zeros((1,), jnp.float32) for c in CONTS}
+    state = SparseTrainState.create(
+        dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adam(1e-2),
+        tables=tables,
+        sparse_opt=sparse_optimizer(kind, lr=1e-2, weight_decay=1e-3),
+    )
+    step = make_sparse_train_step(coll, ctr_sparse_forward(bb), donate=False,
+                                  dedup_lookup=dedup)
+    rr = np.random.default_rng(12)
+    losses = []
+    for _ in range(4):
+        batch = {c: jnp.asarray(rr.integers(0, SIZES[c], 32), jnp.int32)
+                 for c in CATS}
+        batch["x0"] = jnp.asarray(rr.random(32, dtype=np.float32))
+        batch["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    return losses, state, coll
+
+
+def _effective_tables(state, coll):
+    """Logical-table views with hot rows overlaid onto the cold storage."""
+    out = {}
+    for c in CATS:
+        tname = coll.resolve(c)[1].name
+        aname, spec, off = coll.resolve_table(tname)
+        eff = np.asarray(state.tables[aname])[off:off + spec.num_embeddings].copy()
+        k = coll.hot_count(tname)
+        if k:
+            eff[np.asarray(coll.hot_ids[tname])] = np.asarray(
+                state.tables[coll.hot_array_name(tname)])
+        out[c] = eff
+    return out
+
+
+@pytest.mark.parametrize("kind,dedup", [
+    # tier-1 keeps the adaptive kinds (distinct state shapes) + the
+    # non-dedup forward; sgd/adagrad ride the slow tier — their dense_update
+    # math is already pinned by test_dense_update_matches_sparse_reference
+    pytest.param("sgd", True, marks=pytest.mark.slow),
+    pytest.param("adagrad", True, marks=pytest.mark.slow),
+    ("rowwise_adagrad", True), ("adam", True), ("rowwise_adagrad", False),
+])
+def test_hot_cold_matches_single_table(mesh8, kind, dedup):
+    """The tentpole equivalence bar: same seed, same batches — the hot/cold
+    run's losses and EFFECTIVE tables (cold storage with hot rows overlaid)
+    must match the unsplit baseline for every optimizer kind, with fully
+    hot, prefix and scattered tables in the same model, under both forward
+    paths."""
+    l_base, s_base, coll_base = _run_trajectory(mesh8, kind, dedup, None)
+    l_hot, s_hot, coll_hot = _run_trajectory(mesh8, kind, dedup, HOT)
+    np.testing.assert_allclose(l_hot, l_base, rtol=1e-5)
+    eff_base = _effective_tables(s_base, coll_base)
+    eff_hot = _effective_tables(s_hot, coll_hot)
+    for c in CATS:
+        np.testing.assert_allclose(eff_hot[c], eff_base[c],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_hot_cold_requires_gspmd():
+    from tdfo_tpu.train.sparse_step import make_sparse_train_step
+
+    coll = _routed_coll()
+    with pytest.raises(ValueError, match="gspmd"):
+        make_sparse_train_step(coll, lambda d, e, b: 0.0, mode="psum")
+    with pytest.raises(ValueError, match="gspmd"):
+        coll.lookup(coll.init(jax.random.key(0)),
+                    {"full": jnp.zeros((4,), jnp.int32)}, mode="psum")
+
+
+def test_hot_init_gathers_cold_rows(mesh8):
+    """Hot heads must be initialised FROM the cold rows (no extra rng
+    keys): same-seed split and unsplit collections start bit-identical."""
+    mk = lambda hot: ShardedEmbeddingCollection(
+        [EmbeddingSpec("a", 20, 8, features=("a",), sharding="row")],
+        mesh=mesh8, hot_ids=hot)
+    hot = {"a": np.array([2, 5, 11], np.int32)}
+    t_base = mk(None).init(jax.random.key(7))
+    coll = mk(hot)
+    t_split = coll.init(jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(t_split["a"]),
+                                  np.asarray(t_base["a"]))
+    np.testing.assert_array_equal(np.asarray(t_split["a__hot"]),
+                                  np.asarray(t_base["a"])[hot["a"]])
+    # replicated head on the mesh
+    from jax.sharding import PartitionSpec as P
+    assert t_split["a__hot"].sharding.spec == P()
+
+
+def test_trainer_stamps_from_artifact(tmp_path):
+    """The trainer-facing digest contract: collection digests match the
+    artifact digests, and change when the artifact changes."""
+    per = {"a": np.array([2, 5, 11], np.int32)}
+    coll = ShardedEmbeddingCollection(
+        [EmbeddingSpec("a", 20, 8, features=("a",))], hot_ids=per)
+    assert coll.hot_digest() == hot_ids_digest(per)
+    # unsplit collection: no stamps at all
+    plain = ShardedEmbeddingCollection(
+        [EmbeddingSpec("a", 20, 8, features=("a",))])
+    assert plain.hot_digest() == {}

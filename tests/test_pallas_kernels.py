@@ -434,6 +434,54 @@ class TestFatRouted:
                                        rtol=1e-5, atol=1e-6)
 
 
+    # one kind suffices: the drain skip is per-grid structure, not per-math
+    # (the multi-kind parity matrix above covers the math); rowwise_adagrad
+    # d=16 is the multi-row-per-line Criteo layout where parity matters most
+    @pytest.mark.parametrize("kind,d", [("rowwise_adagrad", 16)])
+    def test_one_block_grid(self, kind, d):
+        """nblocks == 1 regression: the final drain used to construct
+        write_copy for the off-parity block index -1, loading ids_ref at a
+        negative SMEM index before the guard.  The drain must be statically
+        skipped for one-block grids and still produce the plain-path
+        result."""
+        from tdfo_tpu.ops.sparse import (
+            SparseOptimizer,
+            dedupe_rows_and_lines,
+            fat_apply_routed,
+        )
+        from tdfo_tpu.ops.pallas_kernels import routed_lines_per_step
+
+        rng = np.random.default_rng(23)
+        lay = line_layout(d, kind)
+        lps = routed_lines_per_step(lay)
+        v, b = 200, lps  # capacity_lines == lps -> exactly one grid block
+        lr, wd = 1e-2, 1e-3
+        table = jnp.asarray(rng.standard_normal((v, d)).astype(np.float32))
+        ids = jnp.asarray(rng.integers(-1, v, b).astype(np.int32))
+        grads = jnp.asarray(rng.standard_normal((b, d)).astype(np.float32))
+        grads = jnp.where((ids >= 0)[:, None], grads, 0.0)
+        opt = SparseOptimizer(kind=kind, lr=lr, weight_decay=wd,
+                              small_vocab_threshold=0)
+        t_ref, _ = opt.update(table, opt.init(table), ids, grads)
+
+        seg, ulines, row_lidx, row_slot = dedupe_rows_and_lines(
+            ids, capacity_rows=b, capacity_lines=lps, rows_per_line=lay.r)
+        fat = fat_pack(table, kind=kind)
+        oob = jnp.iinfo(jnp.int32).max
+        lines = jnp.take(fat, jnp.where(ulines < oob, ulines, 0), axis=0)
+        g_u = jax.ops.segment_sum(grads.astype(jnp.float32), seg,
+                                  num_segments=b)
+        slots = (jnp.zeros((), jnp.int32),) if kind == "adam" else ()
+        for interpret in (True, False):
+            t_new, _ = fat_apply_routed(
+                fat, slots, ulines, g_u, row_lidx, row_slot, lines,
+                embedding_dim=d, kind=kind, lr=lr, weight_decay=wd,
+                interpret=interpret)
+            got = fat_unpack(t_new, lay, rows=v)[0]
+            np.testing.assert_allclose(np.asarray(got), np.asarray(t_ref),
+                                       rtol=1e-5, atol=1e-6)
+
+
 @pytest.mark.parametrize("u", [129, 400])
 def test_fat_multi_block_pipeline(u):
     """>128 touched lines forces multiple grid steps, exercising the

@@ -442,6 +442,69 @@ def test_checkpoint_layout_version_guard(tmp_path):
     mgr3.close()
 
 
+def test_checkpoint_unstamped_probe_failure_guidance(tmp_path):
+    """When the item_metadata probe itself FAILS on a legacy unstamped
+    checkpoint, the early refusal cannot fire and restore used to die with
+    an opaque orbax structure mismatch (the abstract tree expects the
+    layout_version leaf the legacy save never wrote).  That error must now
+    arrive wrapped with the layout-version guidance."""
+    import jax.numpy as jnp
+    import orbax.checkpoint as ocp
+    import pytest
+
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+
+    state = {"w": jnp.arange(6.0).reshape(2, 3)}
+    legacy = ocp.CheckpointManager(
+        (tmp_path / "legacy").absolute(),
+        options=ocp.CheckpointManagerOptions(create=True))
+    legacy.save(0, args=ocp.args.StandardSave(state))
+    legacy.wait_until_finished()
+    legacy.close()
+
+    mgr = CheckpointManager(tmp_path / "legacy")
+
+    def broken_probe(step_id):
+        raise ValueError("simulated metadata schema drift")
+
+    mgr._mgr.item_metadata = broken_probe
+    with pytest.raises(ValueError, match="layout_version"):
+        mgr.restore(state)
+    mgr.close()
+
+
+def test_checkpoint_stamps_mismatch_refused(tmp_path):
+    """The stamps sidecar must round-trip, and ANY asymmetry — different
+    values, missing on either side — refuses the restore (the hot/cold
+    hot-id digest contract: same shapes under a different hot set restore
+    cleanly but pair every hot row with the wrong id)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+
+    state = {"w": jnp.arange(4.0)}
+    mgr = CheckpointManager(tmp_path / "ck")
+    mgr.save(0, state, stamps={"hot_digest": {"item": "abc123"}})
+    # matching stamps restore fine
+    step, restored, _ = mgr.restore(
+        state, stamps={"hot_digest": {"item": "abc123"}})
+    assert step == 0
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.asarray(state["w"]))
+    # wrong digest, missing expectation, or extra expectation: all refused
+    for bad in ({"hot_digest": {"item": "zzz999"}}, None, {"other": 1}):
+        with pytest.raises(ValueError, match="stamps"):
+            mgr.restore(state, stamps=bad)
+    mgr.close()
+    # and the symmetric case: checkpoint without stamps, run expecting some
+    mgr2 = CheckpointManager(tmp_path / "ck2")
+    mgr2.save(0, state)
+    with pytest.raises(ValueError, match="stamps"):
+        mgr2.restore(state, stamps={"hot_digest": {"item": "abc123"}})
+    mgr2.close()
+
+
 def test_bert4rec_dedup_lookup_matches_default(prepared_dir):
     """dedup_lookup on the sequence family ([B, T] ids, fat item table,
     model-parallel mesh): same metrics as the default path."""
