@@ -541,6 +541,52 @@ def bench_embedding_lookup(batch_size: int = 8192, vocab: int = 2_000_000,
         # lookups) clears the few-ms tunnel-latency noise on each fetch
         sec = chain_time(run, make_args, ks=(64, 512), reps=3)
         out[mode] = round(sec * 1e6, 1)  # us
+
+    # The grouped exchange's claim is per-TABLE collective elimination
+    # (2 all_to_all per step regardless of table count vs 2 per table), so
+    # its honest baseline is a MULTI-table spec: same total vocab split
+    # over n_tables, per-table alltoall vs one grouped exchange.
+    n_tables = 8
+    tv = vocab // n_tables
+    specs = [
+        EmbeddingSpec(f"t{i}", tv, dim, features=(f"ids{i}",), sharding="row")
+        for i in range(n_tables)
+    ]
+    for key, grouped in (("alltoall_per_table8", False),
+                         ("alltoall_grouped8", True)):
+        mcoll = ShardedEmbeddingCollection(specs, mesh=mesh,
+                                           grouped_a2a=grouped)
+        mtables = mcoll.init(jax.random.key(0))
+        ids_spec = P(None, "model") if n_shards > 1 else P()
+
+        def run(k, mcoll=mcoll, mtables=mtables):
+            @jax.jit
+            def chain(tables, ids_stack):
+                def body(carry, feats):
+                    feats = {f: (v + carry.astype(jnp.int32)) % tv
+                             for f, v in feats.items()}
+                    vecs = mcoll.lookup(tables, feats, mode="alltoall")
+                    tot = sum(jnp.abs(v).sum() for v in vecs.values())
+                    return tot.astype(jnp.float32) % 1024, None
+
+                final, _ = jax.lax.scan(body, jnp.float32(0), ids_stack)
+                return final
+
+            return lambda stack: chain(mtables, stack)
+
+        def make_args(k, seed, ids_spec=ids_spec):
+            r = np.random.default_rng(seed)
+            stack = {
+                f"ids{i}": jax.device_put(
+                    r.integers(0, tv, (k, batch_size)).astype(np.int32),
+                    NamedSharding(mesh, ids_spec))
+                for i in range(n_tables)
+            }
+            float(sum(jnp.sum(v) for v in stack.values()))
+            return (stack,)
+
+        sec = chain_time(run, make_args, ks=(64, 512), reps=3)
+        out[key] = round(sec * 1e6, 1)  # us
     out["n_shards"] = n_shards
     out["shape"] = f"B{batch_size}xV{vocab}xD{dim}"
     return out

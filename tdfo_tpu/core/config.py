@@ -34,6 +34,7 @@ __all__ = [
     "MeshSpec",
     "FaultSpec",
     "EmbeddingsSpec",
+    "TrainSpec",
     "read_configs",
     "load_size_map",
 ]
@@ -87,6 +88,30 @@ class EmbeddingsSpec:
     # share reaches this fraction (then capped at hot_vocab).  Power-law id
     # traffic typically reaches 0.9 with a tiny prefix.
     hot_fraction: float = 0.9
+    # grouped cross-table all-to-all (torchrec KJTAllToAll input-dist
+    # parity): every row/table-sharded table's ids ride ONE offset-shifted
+    # stream through ONE owner-sort + ONE id `all_to_all` (+ one for the
+    # returned vectors), instead of a sort/bucket pipeline and 2 collectives
+    # per table.  The backward takes the same single grouped id+grad
+    # exchange.  Requires lookup_mode = "alltoall" + model_parallel; losses
+    # are bit-identical to the per-table program.
+    grouped_a2a: bool = False
+
+
+@dataclass(frozen=True)
+class TrainSpec:
+    """``[train]`` config table: train-loop pipelining knobs
+    (torchrec ``TrainPipelineSparseDist`` parity)."""
+
+    # cross-batch input-dist pipelining: batch N+1's owner-bucketing + id
+    # all-to-all (which never reads the tables) is issued inside the jitted
+    # step BEFORE batch N's dense fwd/bwd + table update, so XLA's
+    # latency-hiding scheduler overlaps the ICI exchange with MXU work
+    # (torchrec/train.py TrainPipelineSparseDist).  Losses are bit-identical
+    # to eager order but arrive one batch late; the trainer primes on the
+    # first batch and flushes the last at epoch end.  Requires
+    # grouped_a2a = true and steps_per_execution = 1.
+    pipeline_overlap: bool = False
 
 
 @dataclass(frozen=True)
@@ -209,6 +234,8 @@ class Config:
     fused_table_threshold: int = 16384
     # [embeddings] table: frequency-partitioned hot/cold storage knobs
     embeddings: EmbeddingsSpec = field(default_factory=EmbeddingsSpec)
+    # [train] table: train-loop pipelining knobs
+    train: TrainSpec = field(default_factory=TrainSpec)
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---
@@ -350,6 +377,26 @@ class Config:
                 "lookup_mode \"gspmd\" only: hot tables are replicated and "
                 "routed inside the jitted step, which the explicit psum/"
                 "alltoall shard_map programs do not carry")
+        if self.embeddings.grouped_a2a:
+            if self.lookup_mode != "alltoall":
+                raise ValueError(
+                    "grouped_a2a groups the alltoall exchange and therefore "
+                    "requires lookup_mode = \"alltoall\"")
+            if not self.model_parallel:
+                raise ValueError(
+                    "grouped_a2a requires model_parallel = true: without "
+                    "sharded tables there is no exchange to group")
+        if self.train.pipeline_overlap:
+            if not self.embeddings.grouped_a2a:
+                raise ValueError(
+                    "pipeline_overlap pipelines the grouped input-dist and "
+                    "therefore requires [embeddings] grouped_a2a = true "
+                    "(and lookup_mode = \"alltoall\")")
+            if self.steps_per_execution != 1:
+                raise ValueError(
+                    "pipeline_overlap carries the next batch's input-dist "
+                    "across step boundaries and composes with "
+                    "steps_per_execution = 1 only")
 
     @property
     def effective_fused_threshold(self) -> int | None:
@@ -385,6 +432,7 @@ _CONFIG_FIELDS = {f.name for f in dataclasses.fields(Config)}
 _MESH_FIELDS = {f.name for f in dataclasses.fields(MeshSpec)} - {"axis_names"}
 _FAULT_FIELDS = {f.name for f in dataclasses.fields(FaultSpec)}
 _EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
+_TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainSpec)}
 
 
 def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
@@ -431,6 +479,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                 f"unknown embeddings config keys: {sorted(unknown_emb)}")
         embeddings = EmbeddingsSpec(**emb_raw)
 
+    train_raw = raw.pop("train", {})
+    if isinstance(train_raw, TrainSpec):
+        train = train_raw
+    else:
+        unknown_train = set(train_raw) - _TRAIN_FIELDS
+        if unknown_train:
+            raise ValueError(
+                f"unknown train config keys: {sorted(unknown_train)}")
+        train = TrainSpec(**train_raw)
+
     unknown = set(raw) - _CONFIG_FIELDS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -441,7 +499,8 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
         if key in raw:
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
-    cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, **raw)
+    cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, train=train,
+                 **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

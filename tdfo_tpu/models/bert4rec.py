@@ -158,6 +158,7 @@ def make_sharded_bert4rec(
     a2a_capacity_factor: float | None = None,
     ring_block_k: int | None = None,
     tp_heads: bool = False,
+    grouped_a2a: bool = False,
 ):
     """The DMP-equivalent wiring (``torchrec/train.py:235-254``): item table in
     a ShardedEmbeddingCollection (sharded over ``model``), dense transformer
@@ -188,6 +189,7 @@ def make_sharded_bert4rec(
         mesh=mesh,
         a2a_capacity_factor=a2a_capacity_factor,
         fused_kind=fused_kind,
+        grouped_a2a=grouped_a2a,
     )
     k_table, k_dense = jax.random.split(rng)
     tables = coll.init(k_table)

@@ -37,6 +37,15 @@ Lookup modes:
                    owner shard, ``all_to_all`` the ids, gather locally,
                    ``all_to_all`` the vectors back (input-dist / output-dist
                    parity with DMP's NCCL plan, ``torchrec/train.py:241-247``).
+
+``grouped_a2a=True`` upgrades the alltoall mode to torchrec's GROUPED
+KJTAllToAll input-dist: every row/table-sharded table's ids ride one
+offset-shifted virtual id stream through ONE owner sort and ONE id
+``all_to_all`` (+ one for the returned vectors) per step — O(1) collectives
+per direction instead of O(tables) — and :meth:`grouped_update` gives the
+backward the same single grouped id+grad exchange.  The id half
+(:meth:`grouped_input_dist`) never reads the tables, which is what makes
+cross-batch input-dist pipelining legal (``train/sparse_step.py``).
 """
 
 from __future__ import annotations
@@ -114,6 +123,28 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+@dataclass(frozen=True)
+class _A2AGroup:
+    """Static plan of one grouped-alltoall exchange: all features whose
+    tables share ``(embedding_dim, dtype)`` ride one virtual id stream.
+
+    Per-array ``rows_per_shard`` (vocab rows each model shard owns, derived
+    statically — never from live table values, so the id exchange carries no
+    data dependency on the tables) and cumulative ``bases`` define disjoint
+    per-shard virtual address ranges: feature id ``i`` of array ``a`` maps to
+    ``owner = i // rps_a`` and virtual id ``i - owner * rps_a + base_a``; the
+    owner decodes it back by base range."""
+
+    key: str                               # ctx dict key, "{dim}_{dtype}"
+    dim: int
+    feats: tuple[str, ...]                 # input order (= update stream order)
+    feat_meta: tuple[tuple[int, int], ...]  # per feat: (array idx, row offset)
+    arrays: tuple[str, ...]                # init() pytree keys
+    specs: tuple[EmbeddingSpec, ...]       # representative spec per array
+    rows_per_shard: tuple[int, ...]        # per array
+    bases: tuple[int, ...]                 # per array virtual base
+
+
 def _a2a_bucket_cap(n: int, m: int, cf: float | None) -> int:
     """Per-owner send-bucket capacity of the alltoall lookup program for a
     local batch of ``n`` ids over ``m`` shards under capacity factor ``cf``
@@ -146,6 +177,7 @@ class ShardedEmbeddingCollection:
         stack_tables: bool = False,
         fused_kind: str = "adam",
         hot_ids: Mapping[str, np.ndarray] | None = None,
+        grouped_a2a: bool = False,
     ):
         """``a2a_capacity_factor``: per-shard send-bucket capacity for the
         alltoall lookup program, as a multiple of the balanced share
@@ -188,7 +220,18 @@ class ShardedEmbeddingCollection:
         ``[0, K)`` hot prefixes (the Criteo ETL layout) remap with one
         compare, general sets with one ``searchsorted(method="sort")``.
         Hot/cold composes with lookup mode ``gspmd`` only, and only with
-        plain (non-fused) row/replicated tables."""
+        plain (non-fused) row/replicated tables.
+
+        ``grouped_a2a``: route ``alltoall``-mode lookups for every
+        row/table-sharded table through ONE grouped exchange per
+        (dim, dtype) group (torchrec KJTAllToAll input-dist parity) instead
+        of a 2-collective program per table; the train step then routes
+        those tables' updates through :meth:`grouped_update` (one id + one
+        grad ``all_to_all``).  Lookup values are identical to the per-table
+        program; update numerics are bit-identical when each table serves a
+        single feature (every shipped schema) — tables shared by several
+        features receive the same per-row grad addends in a different
+        (shard-major instead of feature-major) summation order."""
         from tdfo_tpu.ops.pallas_kernels import line_layout
 
         self.fused_kind = fused_kind
@@ -203,6 +246,8 @@ class ShardedEmbeddingCollection:
         if a2a_capacity_factor is not None and a2a_capacity_factor <= 0:
             a2a_capacity_factor = None
         self.a2a_capacity_factor = a2a_capacity_factor
+        self.grouped_a2a = grouped_a2a
+        self._grouped_plans: dict[tuple[str, ...], tuple[_A2AGroup, ...]] = {}
         self.n_shards = mesh.shape[axis] if mesh is not None else 1
         self._feature_to_table: dict[str, str] = {}
         for s in specs:
@@ -647,6 +692,34 @@ class ShardedEmbeddingCollection:
         axis = self.axis
         cf = self.a2a_capacity_factor
         total = jnp.zeros((), jnp.int32)
+        if self.grouped_a2a:
+            # grouped mode: ONE capacity over each group's combined stream
+            # (the cap the real exchange uses), not per-table caps
+            eligible = {
+                f: ids for f, ids in features.items()
+                if (self._feature_to_table.get(f, f) not in self.hot_ids
+                    and self.resolve(f)[1].sharding in ("row", "table"))
+            }
+            for g in self._grouped_plan(tuple(eligible)):
+                flats = self._group_flats(g, eligible)
+                feat_rps = self._group_feat_rps(g)
+
+                def local(*id_parts, _feat_rps=feat_rps):
+                    owner, _ = self._owner_virt(id_parts, _feat_rps)
+                    n = owner.shape[0]
+                    cap = _a2a_bucket_cap(n, m, cf)
+                    counts = jnp.sum(
+                        owner[None, :] == jnp.arange(m)[:, None], axis=1)
+                    dropped = jnp.sum(jnp.maximum(counts - cap, 0))
+                    return jax.lax.psum(dropped.astype(jnp.int32), axis)
+
+                cnt = shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=tuple(P(axis) for _ in flats), out_specs=P(),
+                    check_vma=False,
+                )(*flats)
+                total = total + cnt
+            return total
         for feat, ids in features.items():
             tname, spec, offset = self.resolve(feat)
             if spec.sharding not in ("row", "table"):
@@ -681,6 +754,20 @@ class ShardedEmbeddingCollection:
         """ids -> vectors for every feature.  ids may be any shape; output
         gains a trailing ``embedding_dim`` axis."""
         out: dict[str, jax.Array] = {}
+        if (mode == "alltoall" and self.grouped_a2a and self.mesh is not None
+                and self.n_shards > 1):
+            # grouped exchange covers every row/table-sharded feature; the
+            # rest (replicated tables, and the error paths) fall through to
+            # the per-feature logic below unchanged
+            grouped = {
+                f: ids for f, ids in features.items()
+                if (self._feature_to_table.get(f, f) not in self.hot_ids
+                    and self.resolve(f)[1].sharding in ("row", "table"))
+            }
+            if grouped:
+                out.update(self.grouped_lookup(tables, grouped))
+                features = {f: i for f, i in features.items()
+                            if f not in grouped}
         for feat, ids in features.items():
             if self._feature_to_table.get(feat) in self.hot_ids:
                 out[feat] = self._lookup_hotcold(tables, feat, ids, mode)
@@ -761,6 +848,353 @@ class ShardedEmbeddingCollection:
         """Vocab rows per model-axis shard (fat shards count lines x R)."""
         mult = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
         return (table.shape[0] // self.n_shards) * mult
+
+    # ------------------------------------------------- grouped alltoall
+
+    def _array_vocab_rows(self, array_name: str) -> int:
+        """Padded vocab-row count of an ``init()`` array, derived from the
+        specs alone (matches ``table.shape`` but needs no live array — the
+        grouped input-dist must not carry a data dependency on the tables,
+        or pipelining it ahead of the update would be illegal)."""
+        if array_name in self._fat_groups:  # fat AND plain table stacks
+            _, _, group = self._fat_groups[array_name]
+            return self._stack_rows[group[0].name][1]
+        if array_name.startswith("__stack_"):
+            group = self._groups[array_name]
+            return self._stack_rows[group[0].name][1]
+        spec = self.specs[array_name]
+        unit = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
+        if spec.sharding == "row":
+            unit *= self.n_shards
+        return _round_up(spec.num_embeddings, unit)
+
+    def _array_rep_spec(self, array_name: str) -> EmbeddingSpec:
+        """A representative member spec of an ``init()`` array (stack
+        members share dim/dtype/fused-ness, which is all callers read)."""
+        if array_name in self._fat_groups:
+            return self._fat_groups[array_name][2][0]
+        if array_name.startswith("__stack_"):
+            return self._groups[array_name][0]
+        return self.specs[array_name]
+
+    def _grouped_plan(self, feature_names: tuple[str, ...]) -> tuple[_A2AGroup, ...]:
+        """Static exchange plan for a feature set: one :class:`_A2AGroup`
+        per (embedding_dim, dtype) — vectors of one group share a payload
+        shape, so the whole group rides one ``all_to_all`` pair.  Feature
+        order is preserved (it defines the combined stream's summation
+        order, which the update-parity guarantee depends on)."""
+        plan = self._grouped_plans.get(feature_names)
+        if plan is not None:
+            return plan
+        groups: dict[tuple[int, str], dict] = {}
+        for f in feature_names:
+            tname = self._feature_to_table.get(f, f)
+            if tname in self.hot_ids:
+                raise ValueError(
+                    f"feature {f!r}: hot/cold tables do not compose with "
+                    "the grouped alltoall exchange")
+            aname, spec, off = self.resolve(f)
+            if spec.sharding not in ("row", "table"):
+                raise ValueError(
+                    f"grouped alltoall requires row/table sharding, but "
+                    f"table {spec.name!r} is {spec.sharding!r}")
+            key = (spec.embedding_dim, jnp.dtype(spec.dtype).name)
+            grp = groups.setdefault(key, {"arrays": [], "feats": []})
+            if aname not in grp["arrays"]:
+                grp["arrays"].append(aname)
+            grp["feats"].append((f, grp["arrays"].index(aname), off))
+        entries = []
+        for (dim, dt), grp in sorted(groups.items(), key=lambda kv: str(kv[0])):
+            arrays = tuple(grp["arrays"])
+            rps = tuple(self._array_vocab_rows(a) // self.n_shards
+                        for a in arrays)
+            bases, b = [], 0
+            for r in rps:
+                bases.append(b)
+                b += r
+            entries.append(_A2AGroup(
+                key=f"{dim}_{dt}", dim=dim,
+                feats=tuple(x[0] for x in grp["feats"]),
+                feat_meta=tuple((x[1], x[2]) for x in grp["feats"]),
+                arrays=arrays,
+                specs=tuple(self._array_rep_spec(a) for a in arrays),
+                rows_per_shard=rps, bases=tuple(bases)))
+        plan = tuple(entries)
+        self._grouped_plans[feature_names] = plan
+        return plan
+
+    def _owner_virt(self, id_parts, feat_meta_rps):
+        """Combined (owner, virtual id) stream of a group, inside shard_map.
+
+        Negative (padding) ids keep a virtual id of -1 — they bucket to
+        shard 0 like the per-table program, arrive as invalid, and resolve
+        to zero vectors / dropped grads regardless of which array's base
+        range -1+base would otherwise fall into."""
+        m = self.n_shards
+        owners, virts = [], []
+        for part, (rps, base) in zip(id_parts, feat_meta_rps):
+            o = jnp.clip(part // rps, 0, m - 1)
+            owners.append(o)
+            virts.append(jnp.where(part >= 0, part - o * rps + base, -1))
+        owner = jnp.concatenate(owners) if len(owners) > 1 else owners[0]
+        virt = jnp.concatenate(virts) if len(virts) > 1 else virts[0]
+        return owner, virt
+
+    def _group_flats(self, group: _A2AGroup, features) -> tuple:
+        """Per-feature flattened offset-shifted int32 id streams.  Padding
+        ids stay -1 — an unconditional ``+ off`` would alias them onto the
+        last row of the preceding stack member (``off - 1``), breaking the
+        :meth:`_owner_virt` sentinel contract for stacked tables."""
+        out = []
+        for f, (_, off) in zip(group.feats, group.feat_meta):
+            flat = features[f].reshape(-1)
+            out.append(jnp.where(flat >= 0, flat + off, -1).astype(jnp.int32))
+        return tuple(out)
+
+    def _group_feat_rps(self, group: _A2AGroup) -> tuple:
+        """Per-feature (rows_per_shard, base) of the feature's array."""
+        return tuple((group.rows_per_shard[ai], group.bases[ai])
+                     for ai, _ in group.feat_meta)
+
+    def grouped_input_dist(self, features: Mapping[str, jax.Array]) -> dict:
+        """Phase 1 of the grouped alltoall program (torchrec KJTAllToAll
+        input-dist parity): ONE stable owner sort + ONE id ``all_to_all``
+        over each group's combined virtual id stream.  Reads NO tables —
+        the returned ctx (per group: received id buckets + the unpermute
+        map) is a plain pytree that :meth:`grouped_lookup` completes, and
+        the train pipeline may compute it for batch N+1 before batch N's
+        update.  The owner sort is STABLE so the received stream preserves
+        global batch order — the property that makes :meth:`grouped_update`
+        bit-identical to the per-table path — and so forward/backward drop
+        the SAME overflowed ids under a finite capacity factor."""
+        plan = self._grouped_plan(tuple(features))
+        m = self.n_shards
+        axis = self.axis
+        cf = self.a2a_capacity_factor
+        ctx = {}
+        for g in plan:
+            flats = self._group_flats(g, features)
+            feat_rps = self._group_feat_rps(g)
+
+            def dist(*id_parts, _feat_rps=feat_rps):
+                owner, virt = self._owner_virt(id_parts, _feat_rps)
+                n = owner.shape[0]
+                cap = _a2a_bucket_cap(n, m, cf)
+                iota = jnp.arange(n, dtype=jnp.int32)
+                sorted_owner, sorted_virt, order = jax.lax.sort(
+                    (owner, virt, iota), num_keys=1, is_stable=True)
+                bucket_start = jnp.searchsorted(
+                    sorted_owner, jnp.arange(m), method="sort")
+                src = bucket_start[:, None] + jnp.arange(cap)[None, :]
+                bucket_end = jnp.append(bucket_start[1:], n)
+                in_bucket = src < bucket_end[:, None]
+                send = jnp.where(
+                    in_bucket, jnp.take(sorted_virt, jnp.minimum(src, n - 1)),
+                    -1)
+                recv = jax.lax.all_to_all(
+                    send, axis, split_axis=0, concat_axis=0)
+                pos = iota - jnp.take(bucket_start, sorted_owner)
+                slot = jnp.where(pos < cap, sorted_owner * cap + pos, -1)
+                _, slot_inv = jax.lax.sort(
+                    (order, slot), num_keys=1, is_stable=False)
+                return recv, slot_inv
+
+            recv, slot_inv = shard_map(
+                dist, mesh=self.mesh,
+                in_specs=tuple(P(axis) for _ in flats),
+                out_specs=(P(axis, None), P(axis)),
+                check_vma=False,
+            )(*flats)
+            ctx[g.key] = (recv, slot_inv)
+        return ctx
+
+    def grouped_lookup(
+        self,
+        tables: Mapping[str, jax.Array],
+        features: Mapping[str, jax.Array],
+        ctx: dict | None = None,
+    ) -> dict[str, jax.Array]:
+        """Grouped alltoall lookup: complete a :meth:`grouped_input_dist`
+        ctx (or run it inline) with the owners' gathers and ONE vector
+        ``all_to_all`` per group — 2 collectives per group per step total,
+        vs 2 per TABLE in the per-table program.  Per-feature outputs are
+        split inside the shard_map local function (each shard's block
+        concatenates its feature slices locally, so slicing the logical
+        concat outside would interleave shards wrongly)."""
+        plan = self._grouped_plan(tuple(features))
+        if ctx is None:
+            ctx = self.grouped_input_dist(features)
+        m = self.n_shards
+        axis = self.axis
+        out: dict[str, jax.Array] = {}
+        for g in plan:
+            recv, slot_inv = ctx[g.key]
+            shards = tuple(tables[a] for a in g.arrays)
+            gathers = tuple(self._local_gather(s) for s in g.specs)
+            local_sizes = tuple(features[f].size // m for f in g.feats)
+
+            def complete(recv_l, slot_inv_l, *shards_l, _g=g,
+                         _gathers=gathers, _sizes=local_sizes):
+                flatr = recv_l.reshape(-1)  # [m * cap]
+                valid = flatr >= 0
+                vec = None
+                # per-array masked gathers; base ranges are disjoint, so the
+                # sum of masked rows IS the select across arrays
+                for shard, gather, rps, base in zip(
+                        shards_l, _gathers, _g.rows_per_shard, _g.bases):
+                    loc = flatr - base
+                    mine = valid & (loc >= 0) & (loc < rps)
+                    rows = gather(shard, jnp.clip(loc, 0, rps - 1))
+                    rows = jnp.where(mine[:, None], rows, 0)
+                    vec = rows if vec is None else vec + rows
+                back = jax.lax.all_to_all(
+                    vec.reshape(m, -1, vec.shape[-1]), axis,
+                    split_axis=0, concat_axis=0)
+                flat = back.reshape(-1, vec.shape[-1])
+                outv = jnp.where(
+                    (slot_inv_l >= 0)[:, None],
+                    jnp.take(flat, jnp.maximum(slot_inv_l, 0), axis=0), 0)
+                parts, o = [], 0
+                for nloc in _sizes:
+                    parts.append(outv[o:o + nloc])
+                    o += nloc
+                return tuple(parts)
+
+            parts = shard_map(
+                complete, mesh=self.mesh,
+                in_specs=(P(axis, None), P(axis),
+                          *(P(axis, *([None] * (t.ndim - 1)))
+                            for t in shards)),
+                out_specs=tuple(P(axis) for _ in g.feats),
+                check_vma=False,
+            )(recv, slot_inv, *shards)
+            for f, p in zip(g.feats, parts):
+                out[f] = p.reshape(*features[f].shape, -1)
+        return out
+
+    def _grouped_slot_specs(self, table: jax.Array, slots) -> tuple:
+        """shard_map partition specs for one array's optimizer slots:
+        vocab-aligned state ([V, D] accum/mu/nu, [V] rowwise accum) shards
+        with the table; scalars (adam count, fat-table count) replicate."""
+        return tuple(
+            P(self.axis, *([None] * (leaf.ndim - 1)))
+            if (table.ndim == 2 and leaf.ndim >= 1
+                and leaf.shape[0] == table.shape[0])
+            else P()
+            for leaf in slots)
+
+    def grouped_update(self, opt, tables, slots, ids, grads):
+        """The backward half of the grouped exchange: ship each group's
+        (virtual id, grad) stream to the owner shards with ONE id + ONE
+        grad ``all_to_all``, then dedupe + apply the sparse optimizer on
+        each local shard — replacing one ``opt.update`` (and its implied
+        GSPMD collectives) per table array.
+
+        Bit-exactness vs the per-table path: the stable owner sort delivers
+        each shard its owned contributions in global stream order — the
+        same order ``dedupe_grads``' segment-sum adds them in ``opt.update``
+        — so per-row grad sums and optimizer outputs are identical (single-
+        feature tables; see ``__init__``).  Small-vocab adam tables take
+        the dedupe tier here rather than ``opt.update``'s one-hot tier
+        (a different summation ORDER, same semantics).  Under a finite
+        capacity factor, overflowed ids' grads are dropped — the exact ids
+        whose forward vectors were zeroed.
+
+        ``ids``/``grads`` map feature name -> raw ids / [..., D] grads.
+        Returns ``(new_tables, new_slots)`` dicts covering the plan's
+        arrays only."""
+        from tdfo_tpu.ops.sparse import dedupe_grads, fat_update
+
+        plan = self._grouped_plan(tuple(ids))
+        m = self.n_shards
+        axis = self.axis
+        cf = self.a2a_capacity_factor
+        ceil8 = lambda x: -(-x // 8) * 8
+        new_tables: dict[str, jax.Array] = {}
+        new_slots: dict[str, tuple] = {}
+        for g in plan:
+            flats = self._group_flats(g, ids)
+            gflats = tuple(grads[f].reshape(-1, grads[f].shape[-1])
+                           for f in g.feats)
+            feat_rps = self._group_feat_rps(g)
+            tabs = tuple(tables[a] for a in g.arrays)
+            slot_in = tuple(slots[a] for a in g.arrays)
+            n_local = sum(f.shape[0] for f in flats) // m
+            cap = _a2a_bucket_cap(n_local, m, cf)
+            stream = m * cap
+            # per-array distinct bound: a shard can't touch more rows (fat:
+            # lines) than it owns, +1 for the dedupe sentinel slot
+            mds = []
+            for spec, rps in zip(g.specs, g.rows_per_shard):
+                unit = self.fat_layout(g.dim).r if spec.fused else 1
+                mds.append(min(stream, ceil8(rps // unit + 1)))
+            mds = tuple(mds)
+
+            def local_upd(tabs_l, slots_l, *parts, _g=g, _feat_rps=feat_rps,
+                          _mds=mds, _cap=cap):
+                k = len(_g.feats)
+                owner, virt = self._owner_virt(parts[:k], _feat_rps)
+                gcat = (jnp.concatenate(parts[k:]) if k > 1 else parts[k])
+                n = owner.shape[0]
+                iota = jnp.arange(n, dtype=jnp.int32)
+                sorted_owner, sorted_virt, order = jax.lax.sort(
+                    (owner, virt, iota), num_keys=1, is_stable=True)
+                g_sorted = jnp.take(gcat, order, axis=0)
+                bucket_start = jnp.searchsorted(
+                    sorted_owner, jnp.arange(m), method="sort")
+                src = bucket_start[:, None] + jnp.arange(_cap)[None, :]
+                bucket_end = jnp.append(bucket_start[1:], n)
+                in_bucket = src < bucket_end[:, None]
+                safe = jnp.minimum(src, n - 1)
+                send_ids = jnp.where(
+                    in_bucket, jnp.take(sorted_virt, safe), -1)
+                send_g = jnp.where(
+                    in_bucket[..., None], jnp.take(g_sorted, safe, axis=0), 0)
+                recv_ids = jax.lax.all_to_all(
+                    send_ids, axis, split_axis=0, concat_axis=0).reshape(-1)
+                recv_g = jax.lax.all_to_all(
+                    send_g, axis, split_axis=0, concat_axis=0
+                ).reshape(-1, gcat.shape[-1])
+                out_t, out_s = [], []
+                for shard, sl, spec, rps, base, md in zip(
+                        tabs_l, slots_l, _g.specs, _g.rows_per_shard,
+                        _g.bases, _mds):
+                    loc = recv_ids - base
+                    mine = (recv_ids >= 0) & (loc >= 0) & (loc < rps)
+                    mids = jnp.where(mine, loc, -1)
+                    mg = jnp.where(mine[:, None], recv_g, 0)
+                    if spec.fused:
+                        nt, ns = fat_update(
+                            shard, sl, mids, mg, embedding_dim=_g.dim,
+                            kind=self.fused_kind, lr=opt.lr, b1=opt.b1,
+                            b2=opt.b2, eps=opt.eps,
+                            weight_decay=opt.weight_decay,
+                            capacity=md, max_distinct=md)
+                    else:
+                        uids, gu, valid = dedupe_grads(
+                            mids, mg, capacity=md, vocab=rps,
+                            max_distinct=md)
+                        nt, ns = opt.update_unique(
+                            shard, sl, uids, gu, valid, embedding_dim=_g.dim)
+                    out_t.append(nt)
+                    out_s.append(ns)
+                return tuple(out_t), tuple(out_s)
+
+            tab_specs = tuple(P(axis, *([None] * (t.ndim - 1))) for t in tabs)
+            slot_specs = tuple(self._grouped_slot_specs(t, sl)
+                               for t, sl in zip(tabs, slot_in))
+            upd_t, upd_s = shard_map(
+                local_upd, mesh=self.mesh,
+                in_specs=(tab_specs, slot_specs,
+                          *(P(axis) for _ in flats),
+                          *(P(axis, None) for _ in gflats)),
+                out_specs=(tab_specs, slot_specs),
+                check_vma=False,
+            )(tabs, slot_in, *flats, *gflats)
+            for a, nt, ns in zip(g.arrays, upd_t, upd_s):
+                new_tables[a] = nt
+                new_slots[a] = ns
+        return new_tables, new_slots
 
     def _lookup_psum(self, table: jax.Array, ids: jax.Array,
                      spec: EmbeddingSpec) -> jax.Array:

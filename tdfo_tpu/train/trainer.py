@@ -239,6 +239,33 @@ def _wrap_auc_multi_step(inner, *, donate_state: bool = True):
     return jax.jit(multi, donate_argnums=(0,) if donate_state else ())
 
 
+def _wrap_auc_pipelined(pipe, *, donate_state: bool = False):
+    """Pipelined twin of :func:`_wrap_auc_step`: the step trains the CARRIED
+    batch, so the AUC fold reads the carry's labels — folding the incoming
+    batch's labels would pair them with the previous batch's logits.
+    Returns jitted ``(prime, step, flush)``."""
+
+    def _fold(acc: AUC, labels, logits):
+        ok = jnp.isfinite(logits)  # see _wrap_auc_step
+        return acc.update(labels.astype(jnp.float32),
+                          jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
+                          ok.astype(jnp.float32))
+
+    def step(state, batch, carry, acc: AUC):
+        labels = carry[0]["label"]
+        state, (loss, logits), carry = pipe.step(state, batch, carry)
+        return state, loss, carry, _fold(acc, labels, logits)
+
+    def flush(state, carry, acc: AUC):
+        labels = carry[0]["label"]
+        state, (loss, logits) = pipe.flush(state, carry)
+        return state, loss, _fold(acc, labels, logits)
+
+    d = (0,) if donate_state else ()
+    return (jax.jit(pipe.prime), jax.jit(step, donate_argnums=d),
+            jax.jit(flush, donate_argnums=d))
+
+
 def _commit_replicated(state, mesh):
     """Pin every uncommitted leaf of a state pytree to the mesh, replicated.
 
@@ -286,6 +313,7 @@ class Trainer:
         self._ckpt_stamps = None  # compatibility stamps (hot/cold digests)
         self._logged_steps = 0  # run-global data-step counter (batches consumed)
         self._a2a_overflow = None  # alltoall dropped-id diagnostic (jitted)
+        self._pipelined = False  # train.pipeline_overlap (prime/step/flush)
         self._map_streams: dict = {}  # streaming=false table cache
         # retryable-I/O observability: failed attempts land next to
         # metrics.jsonl (process 0 only; set_failure_log is a no-op path-wise
@@ -466,6 +494,7 @@ class Trainer:
             stack_tables=cfg.stack_tables,
             fused_kind=cfg.sparse_optimizer,
             hot_ids=hot_ids,
+            grouped_a2a=cfg.embeddings.grouped_a2a,
         )
         # hot/cold checkpoints are only loadable under the SAME hot sets —
         # stamp the digests into the checkpoint sidecar so a mismatched
@@ -502,15 +531,35 @@ class Trainer:
                 weight_decay=cfg.weight_decay,
             ),
         ), self.mesh)
-        inner = make_sparse_train_step(
-            coll, ctr_sparse_forward(backbone, with_logits=True),
-            mode=cfg.lookup_mode, jit=False, with_aux=True,
-            dedup_lookup=cfg.dedup_lookup,
-        )
-        if cfg.steps_per_execution > 1:
-            self.train_step = _wrap_auc_multi_step(inner, donate_state=False)
+        if cfg.train.pipeline_overlap:
+            # TrainPipelineSparseDist parity: batch N+1's input-dist issues
+            # inside the jitted step ahead of batch N's fwd/bwd/update.  The
+            # epoch loop primes on the first batch and flushes the last.
+            from tdfo_tpu.train.sparse_step import (
+                make_pipelined_sparse_train_step,
+            )
+
+            if cfg.dedup_lookup:
+                raise ValueError(
+                    "dedup_lookup (gspmd-only) does not compose with "
+                    "train.pipeline_overlap")
+            pipe = make_pipelined_sparse_train_step(
+                coll, ctr_sparse_forward(backbone, with_logits=True),
+                jit=False, with_aux=True,
+            )
+            self._pipelined = True
+            self._prime_step, self.train_step, self._flush_step = (
+                _wrap_auc_pipelined(pipe, donate_state=False))
         else:
-            self.train_step = _wrap_auc_step(inner, donate_state=False)
+            inner = make_sparse_train_step(
+                coll, ctr_sparse_forward(backbone, with_logits=True),
+                mode=cfg.lookup_mode, jit=False, with_aux=True,
+                dedup_lookup=cfg.dedup_lookup,
+            )
+            if cfg.steps_per_execution > 1:
+                self.train_step = _wrap_auc_multi_step(inner, donate_state=False)
+            else:
+                self.train_step = _wrap_auc_step(inner, donate_state=False)
         self._train_auc_enabled = True
         self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
         self._eval_schema = _ctr_eval_schema(cat_cols, cont_cols)
@@ -557,6 +606,7 @@ class Trainer:
             a2a_capacity_factor=cfg.a2a_capacity_factor or None,
             ring_block_k=cfg.ring_block_k or None,
             tp_heads=cfg.tensor_parallel and cfg.attn in ("ring", "ring_flash"),
+            grouped_a2a=cfg.embeddings.grouped_a2a,
         )
         if cfg.tensor_parallel:
             from tdfo_tpu.parallel.sharding import megatron_tp_rule, shard_state
@@ -606,7 +656,24 @@ class Trainer:
                     PAD_ID, n_hosts)
                 return {"item": item, "label": label}
 
-        if cfg.steps_per_execution > 1:
+        if cfg.train.pipeline_overlap:
+            from tdfo_tpu.train.sparse_step import (
+                make_pipelined_sparse_train_step,
+            )
+
+            if cfg.dedup_lookup:
+                raise ValueError(
+                    "dedup_lookup (gspmd-only) does not compose with "
+                    "train.pipeline_overlap")
+            pipe = make_pipelined_sparse_train_step(
+                self.coll, bert4rec_sparse_forward(self.backbone),
+                donate=False, batch_transform=transform,
+            )
+            self._pipelined = True
+            self._prime_step = pipe.prime
+            self.train_step = pipe.step
+            self._flush_step = pipe.flush
+        elif cfg.steps_per_execution > 1:
             self.train_step = make_multi_step(
                 make_sparse_train_step(
                     self.coll, bert4rec_sparse_forward(self.backbone),
@@ -829,6 +896,12 @@ class Trainer:
         # cursor (device histograms): after a mid-epoch resume the epoch AUC
         # covers post-resume steps only.  State evolution is unaffected.
         train_auc = AUC.empty() if self._train_auc_enabled else None
+        # pipeline_overlap carry: (transformed batch, input-dist ctx) one
+        # batch ahead of training.  Not persisted in cursors: n_steps counts
+        # TRAINED batches, so a resume fast-forwards past exactly those and
+        # re-primes on the batch the carry held — state evolution is
+        # bit-identical to the uninterrupted run.
+        carry = None
         tol = cfg.nonfinite_tolerance
         guard = tol > 0
         # pending: (device loss, steps in batch, global data step)
@@ -890,7 +963,19 @@ class Trainer:
                 if profiled is True and n_steps >= 10:
                     jax.profiler.start_trace(str(Path(cfg.checkpoint_dir or ".") / "profile"))
                     profiled = "tracing"
-                if cfg.model == "bert4rec":
+                if self._pipelined and carry is None:
+                    # pipeline prime: the first batch's input-dist only;
+                    # training starts next iteration
+                    carry = self._prime_step(batch)
+                    continue
+                if self._pipelined:
+                    if cfg.model == "bert4rec":
+                        self.state, loss, carry = self.train_step(
+                            self.state, batch, carry, self._dropout_rng)
+                    else:
+                        self.state, loss, carry, train_auc = self.train_step(
+                            self.state, batch, carry, train_auc)
+                elif cfg.model == "bert4rec":
                     self.state, loss = self.train_step(self.state, batch, self._dropout_rng)
                 else:
                     self.state, loss, train_auc = self.train_step(
@@ -937,6 +1022,20 @@ class Trainer:
                     # intervals; advance past n_steps so each interval logs
                     # at most once
                     next_log = n_steps + cfg.log_every_n_steps
+            if self._pipelined and carry is not None:
+                # drain the pipeline: the last carried batch trains here
+                # (flush is prime's twin — together they shift every batch's
+                # training one call later without changing its math)
+                if cfg.model == "bert4rec":
+                    self.state, loss = self._flush_step(
+                        self.state, carry, self._dropout_rng)
+                else:
+                    self.state, loss, train_auc = self._flush_step(
+                        self.state, carry, train_auc)
+                carry = None
+                n_steps += 1
+                pending.append((loss, 1, self._logged_steps + n_steps))
+                pending_steps += 1
         finally:
             if profiled == "tracing":
                 # epoch ended (or raised) inside the trace window: close the

@@ -192,3 +192,47 @@ def test_bert4rec_rejects_tfrecord():
     with _pytest.raises(ValueError, match="bert4rec"):
         Config(model="bert4rec", write_format="tfrecord")
     Config(model="bert4rec", write_format="parquet")
+
+
+def test_train_table(tmp_path: Path):
+    """The [train] section maps onto TrainSpec; unknown keys rejected,
+    both pipelining knobs default OFF."""
+    cfg = read_configs()
+    assert cfg.train.pipeline_overlap is False
+    assert cfg.embeddings.grouped_a2a is False
+    (tmp_path / "config.toml").write_text(
+        "model_parallel = true\nlookup_mode = \"alltoall\"\n"
+        "[embeddings]\ngrouped_a2a = true\n"
+        "[train]\npipeline_overlap = true\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.embeddings.grouped_a2a is True
+    assert cfg.train.pipeline_overlap is True
+    (tmp_path / "config.toml").write_text("[train]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="bogus"):
+        read_configs(tmp_path / "config.toml")
+
+
+def test_grouped_a2a_knob_validation():
+    from tdfo_tpu.core.config import EmbeddingsSpec, TrainSpec
+
+    # grouped_a2a groups the alltoall exchange: other lookup modes have no
+    # per-table collectives to group
+    with pytest.raises(ValueError, match="alltoall"):
+        Config(embeddings=EmbeddingsSpec(grouped_a2a=True),
+               model_parallel=True)
+    with pytest.raises(ValueError, match="model_parallel"):
+        Config(embeddings=EmbeddingsSpec(grouped_a2a=True),
+               lookup_mode="alltoall")
+    Config(embeddings=EmbeddingsSpec(grouped_a2a=True),
+           lookup_mode="alltoall", model_parallel=True)
+    # pipeline_overlap rides the grouped input-dist and single-step dispatch
+    with pytest.raises(ValueError, match="grouped_a2a"):
+        Config(train=TrainSpec(pipeline_overlap=True))
+    with pytest.raises(ValueError, match="steps_per_execution"):
+        Config(train=TrainSpec(pipeline_overlap=True),
+               embeddings=EmbeddingsSpec(grouped_a2a=True),
+               lookup_mode="alltoall", model_parallel=True,
+               steps_per_execution=4)
+    Config(train=TrainSpec(pipeline_overlap=True),
+           embeddings=EmbeddingsSpec(grouped_a2a=True),
+           lookup_mode="alltoall", model_parallel=True)
