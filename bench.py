@@ -671,6 +671,126 @@ def bench_big_table(vocab_tiny: int = 2_000_000, vocab_small: int = 50_000_000,
     return out
 
 
+def bench_serving(batch_size: int = 8192, embed_dim: int = 64,
+                  top_k: int = 100) -> dict:
+    """Serving-path latency: the frontend's jitted scoring program at its
+    largest bucket and the exact-retrieval program, timed by the same
+    chain differencing as the train benches (CLAUDE.md tunnel rules:
+    ``block_until_ready`` does not wait through the tunnel; only value
+    fetches sync, and the constant ~100 ms RPC cancels in the K2-K1
+    difference).
+
+    ``serve_score8`` / ``serve_retrieve8``: per-batch latency at B=8192
+    plus the derived throughput (scored rows/sec; retrieval queries/sec
+    against the full 200k-item corpus at ``top_k``).  Both programs take
+    tables/corpus as chain ARGUMENTS — never closures (compile payload).
+    """
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+    from tdfo_tpu.models.twotower import TwoTowerBackbone, ctr_embedding_specs
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+    from tdfo_tpu.serve.corpus import build_corpus, synthetic_item_features
+    from tdfo_tpu.serve.export import export_bundle, load_bundle
+    from tdfo_tpu.serve.retrieval import make_retrieval
+    from tdfo_tpu.serve.scoring import make_scorer
+    from tdfo_tpu.train.sparse_step import SparseTrainState
+
+    import optax
+
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    coll = ShardedEmbeddingCollection(
+        ctr_embedding_specs(SIZE_MAP, embed_dim, "row"), mesh=mesh)
+    backbone = TwoTowerBackbone(embed_dim=embed_dim)
+    dummy_e = {f: jnp.zeros((1, embed_dim), jnp.float32) for f in coll.features()}
+    dummy_c = {"avg_rating": jnp.zeros((1,)), "num_pages": jnp.zeros((1,))}
+    state = SparseTrainState.create(
+        dense_params=backbone.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adamw(3e-4), tables=coll.init(jax.random.key(0)),
+        sparse_opt=sparse_optimizer("adam", lr=3e-4),
+    )
+    with tempfile.TemporaryDirectory() as td:
+        bundle = load_bundle(export_bundle(
+            td + "/bundle", model="twotower", embed_dim=embed_dim,
+            cat_columns=("user_id", "item_id", "language", "is_ebook",
+                         "format", "publisher", "pub_decade"),
+            cont_columns=("avg_rating", "num_pages"), size_map=SIZE_MAP,
+            coll=coll, tables=state.tables, dense_params=state.dense_params))
+    scorer = make_scorer(bundle, mesh=mesh)
+    corpus_items = SIZE_MAP["item"]
+    out: dict[str, object] = {"batch": batch_size, "top_k": top_k,
+                              "corpus_items": corpus_items,
+                              "embed_dim": embed_dim}
+
+    # scoring chain: each scanned batch folds the carry into its ids so no
+    # two scored batches are identical (defeats result caching)
+    s_tables, s_dense = scorer._params
+
+    def run_score(k):
+        @jax.jit
+        def chain(tables, dense, stack):
+            def body(carry, batch):
+                batch = dict(batch)
+                batch["user_id"] = (batch["user_id"] + carry) % SIZE_MAP["user"]
+                logits = scorer._score(batch, tables, dense)
+                return jnp.abs(logits).sum().astype(jnp.int32) % 128, None
+
+            final, _ = jax.lax.scan(body, jnp.int32(0), stack)
+            return final
+
+        return lambda stack: chain(s_tables, s_dense, stack)
+
+    def make_score_args(k, seed):
+        r = np.random.default_rng(seed)
+        host = _make_host_batch(r, batch_size * k)
+        host.pop("label")
+        return (_stack_batches(mesh, host, k, batch_size),)
+
+    sec = chain_time(run_score, make_score_args, ks=(16, 128), reps=3)
+    out["serve_score8"] = {
+        "batch_ms": round(sec * 1e3, 3),
+        "rows_per_sec": round(batch_size / sec, 1),
+    }
+
+    corpus = build_corpus(
+        scorer, synthetic_item_features(SIZE_MAP, corpus_items, seed=0),
+        corpus_batch=8192, mesh=mesh)
+    retrieve = make_retrieval(corpus, mesh=mesh, top_k=top_k)
+
+    def run_retrieve(k):
+        @jax.jit
+        def chain(vectors, ids, qstack):
+            def body(carry, q):
+                s, _ = retrieve.jitted(q + carry, vectors, ids)
+                return jnp.abs(s).sum() * jnp.float32(1e-9), None
+
+            final, _ = jax.lax.scan(body, jnp.float32(0), qstack)
+            return final
+
+        return lambda qstack: chain(corpus.vectors, corpus.ids, qstack)
+
+    def make_retrieve_args(k, seed):
+        import jax
+
+        r = np.random.default_rng(seed)
+        q = jax.device_put(
+            r.standard_normal((k, batch_size, embed_dim)).astype(np.float32))
+        float(jnp.sum(q))
+        return (q,)
+
+    sec = chain_time(run_retrieve, make_retrieve_args, ks=(16, 128), reps=3)
+    out["serve_retrieve8"] = {
+        "batch_ms": round(sec * 1e3, 3),
+        "queries_per_sec": round(batch_size / sec, 1),
+    }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=8192)
@@ -688,6 +808,9 @@ def main() -> None:
                          "Criteo-Kaggle tables, 33.76M rows, stacked, "
                          "rowwise-adagrad)")
     ap.add_argument("--skip-big-table", action="store_true")
+    ap.add_argument("--skip-serving", action="store_true",
+                    help="skip the serving-path records (serve_score8 / "
+                         "serve_retrieve8)")
     ap.add_argument("--hot-vocab", type=int, default=0,
                     help="dlrm-criteo only: split every table's [0, K) "
                          "frequency-ranked prefix into a replicated hot head "
@@ -764,6 +887,13 @@ def main() -> None:
         except Exception as e:  # the demo must never kill the headline
             print(f"bench: big-table demo failed: {e!r}", file=sys.stderr)
 
+    serving = {}
+    if on_tpu and not args.skip_serving and not args.dense:
+        try:
+            serving = bench_serving(args.batch_size)
+        except Exception as e:  # serving records must never kill the headline
+            print(f"bench: serving bench failed: {e!r}", file=sys.stderr)
+
     repo = Path(__file__).parent
     baseline_path = repo / "BENCH_BASELINE.json"
     model_name = "twotower" if args.dense else args.model
@@ -789,6 +919,7 @@ def main() -> None:
         "mfu": round(mfu, 5),
         "embedding_lookup_p50_us": lookup,
         "big_table_demo": big_table,
+        "serving": serving,
         "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,

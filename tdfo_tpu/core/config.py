@@ -34,6 +34,7 @@ __all__ = [
     "MeshSpec",
     "FaultSpec",
     "EmbeddingsSpec",
+    "ServingSpec",
     "TrainSpec",
     "read_configs",
     "load_size_map",
@@ -96,6 +97,38 @@ class EmbeddingsSpec:
     # exchange.  Requires lookup_mode = "alltoall" + model_parallel; losses
     # are bit-identical to the per-table program.
     grouped_a2a: bool = False
+
+
+@dataclass(frozen=True)
+class ServingSpec:
+    """``[serving]`` config table: online-inference knobs for the
+    ``serve`` subcommand (``tdfo_tpu/serve/``) — checkpoint export,
+    exact-MIPS candidate retrieval, and the micro-batching frontend.
+
+    Every key is observable (``tests/test_config.py``): ``top_k`` is the
+    retrieval output width, ``corpus_batch`` the item-tower sweep chunk,
+    ``max_batch``/``batch_deadline_ms``/``buckets`` drive micro-batch
+    assembly and the padded-shape set the jit cache may hold.
+    """
+
+    # retrieved candidates per query (``lax.top_k`` width; ~16 us for an
+    # 8k argsort on v5e, so exact brute-force MIPS needs no ANN index at
+    # Goodreads/Criteo corpus scales)
+    top_k: int = 100
+    # item-tower sweep chunk when materialising the [N_items, D] corpus —
+    # one jitted program, N/corpus_batch dispatches
+    corpus_batch: int = 8192
+    # micro-batcher flush threshold: a batch ships as soon as it holds
+    # this many rows (must fit the largest bucket)
+    max_batch: int = 8192
+    # oldest-request deadline in milliseconds: when it expires the batcher
+    # ships a PARTIAL padded batch instead of stalling the queue (graceful
+    # degradation; 0 ships every request as its own batch)
+    batch_deadline_ms: float = 10.0
+    # allowed padded batch shapes (ascending).  Requests pad up to the
+    # smallest bucket that fits, so the serving jit cache holds at most
+    # ``len(buckets)`` programs — the compile-count regression contract.
+    buckets: tuple[int, ...] = (256, 1024, 8192)
 
 
 @dataclass(frozen=True)
@@ -236,6 +269,8 @@ class Config:
     embeddings: EmbeddingsSpec = field(default_factory=EmbeddingsSpec)
     # [train] table: train-loop pipelining knobs
     train: TrainSpec = field(default_factory=TrainSpec)
+    # [serving] table: online-inference knobs (launch serve / tdfo_tpu.serve)
+    serving: ServingSpec = field(default_factory=ServingSpec)
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---
@@ -386,6 +421,29 @@ class Config:
                 raise ValueError(
                     "grouped_a2a requires model_parallel = true: without "
                     "sharded tables there is no exchange to group")
+        if self.serving.top_k < 1:
+            raise ValueError("serving top_k must be >= 1")
+        if self.serving.corpus_batch < 1:
+            raise ValueError("serving corpus_batch must be >= 1")
+        if self.serving.max_batch < 1:
+            raise ValueError("serving max_batch must be >= 1")
+        if self.serving.batch_deadline_ms < 0:
+            raise ValueError(
+                "serving batch_deadline_ms must be >= 0 (0 = ship every "
+                "request immediately)")
+        if not self.serving.buckets:
+            raise ValueError("serving buckets must name at least one shape")
+        if any(b < 1 for b in self.serving.buckets):
+            raise ValueError("serving buckets must be positive batch shapes")
+        if list(self.serving.buckets) != sorted(set(self.serving.buckets)):
+            raise ValueError(
+                "serving buckets must be strictly increasing (each padded "
+                "shape compiles one program; duplicates/disorder hide that)")
+        if self.serving.max_batch > self.serving.buckets[-1]:
+            raise ValueError(
+                "serving max_batch must fit the largest bucket: a full batch "
+                f"of {self.serving.max_batch} rows cannot pad into "
+                f"buckets[-1] = {self.serving.buckets[-1]}")
         if self.train.pipeline_overlap:
             if not self.embeddings.grouped_a2a:
                 raise ValueError(
@@ -433,6 +491,7 @@ _MESH_FIELDS = {f.name for f in dataclasses.fields(MeshSpec)} - {"axis_names"}
 _FAULT_FIELDS = {f.name for f in dataclasses.fields(FaultSpec)}
 _EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
 _TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainSpec)}
+_SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingSpec)}
 
 
 def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
@@ -489,6 +548,19 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                 f"unknown train config keys: {sorted(unknown_train)}")
         train = TrainSpec(**train_raw)
 
+    serving_raw = raw.pop("serving", {})
+    if isinstance(serving_raw, ServingSpec):
+        serving = serving_raw
+    else:
+        unknown_serving = set(serving_raw) - _SERVING_FIELDS
+        if unknown_serving:
+            raise ValueError(
+                f"unknown serving config keys: {sorted(unknown_serving)}")
+        if "buckets" in serving_raw:
+            serving_raw = dict(serving_raw,
+                               buckets=tuple(serving_raw["buckets"]))
+        serving = ServingSpec(**serving_raw)
+
     unknown = set(raw) - _CONFIG_FIELDS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -500,7 +572,7 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
     cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, train=train,
-                 **raw)
+                 serving=serving, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

@@ -8,6 +8,9 @@ no TF_CONFIG / cluster.json / torchx env plumbing (SURVEY.md §5.6).
 
 Subcommands:
   * ``train`` (default)      — build the Trainer from config and fit.
+  * ``serve``                — export the newest checkpoint to a serving
+    bundle and run the micro-batching scoring frontend (+ a retrieval round
+    for TwoTower); knobs live in the ``[serving]`` config table.
   * ``preprocess-ctr``       — TwoTower ETL (jax-flax/preprocessing parity).
   * ``preprocess-seq``       — Bert4Rec ETL (torchrec/preprocessing parity).
   * ``preprocess-criteo``    — Criteo-format ETL (BASELINE.json DLRM family).
@@ -37,8 +40,9 @@ def _init_distributed(flag: str) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
     p.add_argument("command", nargs="?", default="train",
-                   choices=["train", "preprocess-ctr", "preprocess-seq",
-                            "preprocess-criteo", "synth", "synth-criteo"])
+                   choices=["train", "serve", "preprocess-ctr",
+                            "preprocess-seq", "preprocess-criteo", "synth",
+                            "synth-criteo"])
     p.add_argument("--config", default="config.toml", help="path to config.toml")
     p.add_argument("--data-dir", default=None, help="override config data_dir")
     p.add_argument("--distributed", default="auto", choices=["auto", "always", "never"],
@@ -113,6 +117,14 @@ def main(argv: list[str] | None = None) -> int:
         # harness, tdfo_tpu/utils/faults.py) — make that impossible to miss
         # in the launch log of a run that mysteriously dies with exit 17
         print(f"WARNING: fault injection armed: {cfg.faults}", flush=True)
+    if args.command == "serve":
+        from tdfo_tpu.serve.frontend import serve_from_config
+
+        stats = serve_from_config(cfg, log_dir=args.log_dir)
+        print({k: round(v, 5) if isinstance(v, float) else v
+               for k, v in stats.items()})
+        return 0
+
     from tdfo_tpu.train.trainer import Trainer
 
     metrics = Trainer(cfg, log_dir=args.log_dir).fit()

@@ -1,0 +1,47 @@
+"""Online serving subsystem: checkpoint export, corpus build, sharded exact
+MIPS retrieval, train-parity CTR scoring, and the micro-batching frontend.
+
+The inference half of the ROADMAP north star ("serves heavy traffic from
+millions of users").  Layering, offline to online:
+
+  * :mod:`~tdfo_tpu.serve.export`    — train state -> serving bundle on disk
+    (optimizer slots dropped, hot heads merged back, stamped + refused on
+    mismatch like training restores).
+  * :mod:`~tdfo_tpu.serve.scoring`   — bundle -> jitted CTR scoring step whose
+    logits are bitwise the training eval step's (train/serve skew = 0).
+  * :mod:`~tdfo_tpu.serve.corpus`    — batched item-tower sweep materialising
+    the [N_items, D] candidate corpus, sharded over the mesh data axis.
+  * :mod:`~tdfo_tpu.serve.retrieval` — sharded exact top-k MIPS, bitwise-equal
+    to a single-device argsort reference.
+  * :mod:`~tdfo_tpu.serve.frontend`  — deadline/bucket micro-batching request
+    loop with per-request latency JSONL; ``launch.py serve`` entry point.
+"""
+
+from tdfo_tpu.serve.corpus import Corpus, build_corpus, synthetic_item_features
+from tdfo_tpu.serve.export import (
+    BUNDLE_VERSION,
+    ServingBundle,
+    export_bundle,
+    load_bundle,
+    merged_tables,
+)
+from tdfo_tpu.serve.frontend import MicroBatcher, serve_from_config
+from tdfo_tpu.serve.retrieval import make_retrieval, mips_scores, retrieval_reference
+from tdfo_tpu.serve.scoring import make_scorer
+
+__all__ = [
+    "BUNDLE_VERSION",
+    "Corpus",
+    "MicroBatcher",
+    "ServingBundle",
+    "build_corpus",
+    "export_bundle",
+    "load_bundle",
+    "make_retrieval",
+    "make_scorer",
+    "merged_tables",
+    "mips_scores",
+    "retrieval_reference",
+    "serve_from_config",
+    "synthetic_item_features",
+]

@@ -1,0 +1,123 @@
+"""Candidate corpus build: batched item-tower sweep over the full catalog.
+
+Materialises the ``[N_items, D]`` corpus the retrieval layer searches —
+the offline half of the ScaNN-style retrieval split (Guo et al. 2020): item
+vectors are precomputed in bulk, only the user tower runs per request.  The
+sweep reuses the scorer's jitted item tower (``serve/scoring.py``), i.e. the
+``ShardedEmbeddingCollection`` lookup path — plain full-row gathers, ZERO
+scatters (CLAUDE.md: scatters are ~170 ns/row on v5e and have no place in
+any serving program).  One compiled program (fixed ``corpus_batch`` chunk
+shape, last chunk padded) serves the whole sweep.
+
+The finished corpus is sharded over the mesh DATA axis — retrieval is
+corpus-sharded, every device scores all queries against its slice — with
+zero-row padding (ids = -1) up to a shard multiple so uneven catalogs
+(``N % devices != 0``) shard cleanly; retrieval masks padded rows to -inf.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tdfo_tpu.core.mesh import DATA_AXIS
+from tdfo_tpu.models.twotower import (
+    TWOTOWER_CONTINUOUS,
+    TWOTOWER_ITEM_CATEGORICAL,
+    _FEATURE_TO_INPUT,
+)
+from tdfo_tpu.serve.scoring import Scorer
+
+__all__ = ["Corpus", "build_corpus", "synthetic_item_features"]
+
+# item-side input columns of the TwoTower catalog, id column first
+ITEM_COLUMNS = tuple(_FEATURE_TO_INPUT[f] for f in TWOTOWER_ITEM_CATEGORICAL)
+
+
+@dataclass(frozen=True)
+class Corpus:
+    """Sharded candidate corpus: ``vectors[i]`` scores item ``ids[i]``;
+    rows with ``ids[i] == -1`` are shard-alignment padding (masked to -inf
+    by retrieval, never returned)."""
+
+    vectors: jax.Array  # [N_pad, D], sharded P(data, None) under a mesh
+    ids: jax.Array  # [N_pad] int32, sharded P(data); -1 = padding
+    n_items: int  # real rows (N_pad >= n_items)
+
+
+def synthetic_item_features(
+    size_map: Mapping[str, int], n_items: int, seed: int = 0
+) -> dict[str, np.ndarray]:
+    """Deterministic per-item catalog features for demos/tests: categorical
+    attributes drawn within each vocab, continuous in [0, 1).  Real
+    deployments replace this with the item-attribute catalog the CTR ETL
+    joins on (``jax-flax/preprocessing.py`` book metadata)."""
+    rng = np.random.default_rng(seed)
+    feats: dict[str, np.ndarray] = {
+        "item_id": np.arange(n_items, dtype=np.int32)}
+    for feat in TWOTOWER_ITEM_CATEGORICAL[1:]:  # skip the id column itself
+        col = _FEATURE_TO_INPUT[feat]
+        feats[col] = rng.integers(
+            0, int(size_map[feat]), size=n_items, dtype=np.int32)
+    for col in TWOTOWER_CONTINUOUS:
+        feats[col] = rng.random(n_items, dtype=np.float32)
+    return feats
+
+
+def build_corpus(
+    scorer: Scorer,
+    item_features: Mapping[str, np.ndarray],
+    *,
+    corpus_batch: int = 8192,
+    mesh=None,
+    axis: str = DATA_AXIS,
+) -> Corpus:
+    """Sweep the item tower over ``item_features`` -> :class:`Corpus`.
+
+    ``item_features`` maps every item-side input column (``item_id``,
+    attribute columns, continuous columns) to an aligned ``[N]`` array;
+    ``item_id`` defaults to ``arange(N)``.  Chunks of ``corpus_batch`` rows
+    keep the sweep at ONE compiled program; the last chunk zero-pads (valid
+    ids, rows sliced off after) rather than compiling a ragged tail shape.
+    """
+    feats = {k: np.asarray(v) for k, v in item_features.items()}
+    n_items = len(next(iter(feats.values())))
+    feats.setdefault("item_id", np.arange(n_items, dtype=np.int32))
+    for k, v in feats.items():
+        if len(v) != n_items:
+            raise ValueError(
+                f"item_features column {k!r} has {len(v)} rows, expected "
+                f"{n_items} (all columns must align)")
+    missing = [c for c in (*ITEM_COLUMNS, *scorer.cont_columns)
+               if c not in feats]
+    if missing:
+        raise ValueError(f"item_features missing columns {missing}")
+
+    chunks = []
+    for start in range(0, n_items, corpus_batch):
+        stop = min(start + corpus_batch, n_items)
+        pad = corpus_batch - (stop - start)
+        batch = {
+            k: jnp.asarray(np.pad(v[start:stop], [(0, pad)]))
+            for k, v in feats.items()
+        }
+        vecs = scorer.item_embed(batch)
+        chunks.append(vecs[:stop - start] if pad else vecs)
+    vectors = jnp.concatenate(chunks, axis=0).astype(jnp.float32)
+    ids = jnp.arange(n_items, dtype=jnp.int32)
+
+    n_shards = mesh.shape[axis] if mesh is not None else 1
+    n_pad = -(-n_items // n_shards) * n_shards - n_items
+    if n_pad:
+        vectors = jnp.pad(vectors, [(0, n_pad), (0, 0)])
+        ids = jnp.pad(ids, [(0, n_pad)], constant_values=-1)
+    if mesh is not None:
+        vectors = jax.device_put(
+            vectors, NamedSharding(mesh, P(axis, None)))
+        ids = jax.device_put(ids, NamedSharding(mesh, P(axis)))
+    return Corpus(vectors=vectors, ids=ids, n_items=n_items)

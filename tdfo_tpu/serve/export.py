@@ -1,0 +1,292 @@
+"""Checkpoint -> serving bundle: the training/serving parameter contract.
+
+Industrial recsys stacks keep this contract explicit (Monolith, Liu et al.
+2022: training checkpoints are periodically snapshotted into parameter-server
+serving replicas); the reference's closest analogue is the flax byte blob
+written once at train end (``jax-flax/models.py:128-139``).  Here the bundle
+is a directory with a JSON manifest + one ``arrays.npz``:
+
+  * optimizer slots are DROPPED — fused fat-line tables are unpacked
+    (``ops/pallas_kernels.fat_unpack``) back to plain ``[V, d]`` rows, stacked
+    arrays (``__tablestack_`` / ``__fatstack_`` / ``__stack_``) are de-stacked
+    to logical tables, and row-shard padding rows are sliced off;
+  * ``{name}__hot`` replicated heads are merged back into their cold rows
+    (the live values — the duplicated cold rows are dead storage during
+    training, ``parallel/embedding.py init``), so bundles are
+    hot/cold-agnostic: a split and an unsplit run of the same state export
+    byte-identical tables;
+  * an optional bf16 cast policy via :func:`tdfo_tpu.core.precision.compute_dtype`
+    (off by default: f32 bundles keep serving logits bitwise equal to
+    training eval logits);
+  * the manifest stamps ``bundle_version`` + a per-array schema, and
+    :func:`load_bundle` REFUSES version/schema mismatches with a clear error
+    instead of serving scrambled rows — the same stance as the training
+    restore path (``train/checkpoint.py LAYOUT_VERSION`` / stamps sidecar).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tdfo_tpu.core.precision import compute_dtype
+from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+
+__all__ = [
+    "BUNDLE_VERSION",
+    "ServingBundle",
+    "export_bundle",
+    "load_bundle",
+    "merged_tables",
+]
+
+# Bundle schema version, stamped into every manifest and verified on load.
+# Bump on any change that would load without shape errors but scramble
+# values (array key scheme, table packing, param flattening).
+BUNDLE_VERSION = 1
+
+_MANIFEST = "bundle.json"
+_ARRAYS = "arrays.npz"
+
+
+def merged_tables(
+    coll: ShardedEmbeddingCollection, tables: Mapping[str, jax.Array]
+) -> dict[str, np.ndarray]:
+    """Live ``init()`` pytree -> logical ``{table_name: [V, d] f32}`` rows.
+
+    Inverts every storage transform the collection applies: fat-line packing
+    (optimizer state dropped), table stacking (member slices), row-shard
+    padding (sliced to ``num_embeddings``), and the hot/cold split (hot head
+    rows written back over their dead cold duplicates).  Host-side numpy —
+    export is offline, so the scatter-avoidance rules for jitted steps do
+    not apply here.
+    """
+    from tdfo_tpu.ops.pallas_kernels import fat_view
+
+    views: dict[str, np.ndarray] = {}  # array name -> [rows, >=d] host view
+    out: dict[str, np.ndarray] = {}
+    for tname, spec in coll.specs.items():
+        aname, _, off = coll.resolve_table(tname)
+        if aname not in views:
+            arr = jax.device_get(tables[aname])
+            if arr.ndim == 3:  # fused fat lines [L, T, 128]
+                lay = coll.fat_layout(coll.array_embedding_dim(aname))
+                arr = np.asarray(fat_view(jnp.asarray(arr), lay))
+            views[aname] = np.asarray(arr)
+        d = spec.embedding_dim
+        rows = np.array(
+            views[aname][off:off + spec.num_embeddings, :d], dtype=np.float32
+        )
+        hids = coll.hot_ids.get(tname)
+        if hids is not None:
+            hot = np.asarray(
+                jax.device_get(tables[coll.hot_array_name(tname)]),
+                dtype=np.float32,
+            )
+            rows[hids] = hot
+        out[tname] = rows
+    return out
+
+
+@dataclass(frozen=True)
+class ServingBundle:
+    """A loaded serving bundle (see :func:`export_bundle` for the contract).
+
+    ``kind`` = "sparse" (DMP regime: logical ``tables`` + backbone
+    ``dense_params``) or "dense" (replicated TwoTower: one full flax
+    ``params`` tree, ``nn.Embed`` tables included)."""
+
+    kind: str
+    model: str
+    embed_dim: int
+    cat_columns: tuple[str, ...]
+    cont_columns: tuple[str, ...]
+    size_map: dict[str, int]
+    step: int
+    dtype: str  # "float32" | "bfloat16" — the export cast policy
+    tables: dict[str, np.ndarray] | None  # sparse kind
+    dense_params: dict | None  # sparse kind
+    params: dict | None  # dense kind
+
+    @property
+    def jax_dtype(self):
+        return jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+
+
+def _flatten(tree: Mapping[str, Any], prefix: str = "") -> dict[str, np.ndarray]:
+    flat: dict[str, np.ndarray] = {}
+    for k, v in tree.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, Mapping):
+            flat.update(_flatten(v, key))
+        else:
+            flat[key] = np.asarray(jax.device_get(v))
+    return flat
+
+
+def _unflatten(flat: Mapping[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for key, v in flat.items():
+        node = tree
+        *parents, leaf = key.split("/")
+        for p in parents:
+            node = node.setdefault(p, {})
+        node[leaf] = v
+    return tree
+
+
+def _store(arr: np.ndarray, dtype: jnp.dtype) -> np.ndarray:
+    """Apply the cast policy; bf16 ships as uint16 bit patterns (npz has no
+    native bfloat16) and the manifest dtype tells the loader to view back."""
+    if not np.issubdtype(arr.dtype, np.floating):
+        return arr
+    if dtype == jnp.bfloat16:
+        return np.asarray(arr, dtype=jnp.bfloat16).view(np.uint16)
+    return np.asarray(arr, np.float32)
+
+
+def _load_stored(arr: np.ndarray, dtype_name: str) -> np.ndarray:
+    if dtype_name == "bfloat16" and arr.dtype == np.uint16:
+        return arr.view(jnp.bfloat16)
+    return arr
+
+
+def export_bundle(
+    out_dir: str | Path,
+    *,
+    model: str,
+    embed_dim: int,
+    cat_columns: tuple[str, ...],
+    cont_columns: tuple[str, ...],
+    size_map: Mapping[str, int],
+    step: int = 0,
+    coll: ShardedEmbeddingCollection | None = None,
+    tables: Mapping[str, jax.Array] | None = None,
+    dense_params: Mapping[str, Any] | None = None,
+    params: Mapping[str, Any] | None = None,
+    mixed_precision: bool = False,
+    platform: str | None = None,
+) -> Path:
+    """Write a serving bundle directory and return its path.
+
+    Sparse/DMP regime: pass ``coll`` + ``tables`` + ``dense_params`` (the
+    ``SparseTrainState`` pieces); tables are merged via :func:`merged_tables`.
+    Dense regime (replicated TwoTower): pass ``params`` (the full flax tree).
+    ``mixed_precision=True`` applies the platform cast policy
+    (:func:`compute_dtype`: bf16 on TPU) to every floating array; the default
+    keeps f32 so serving logits stay bitwise equal to training eval logits.
+    """
+    if (coll is None) == (params is None):
+        raise ValueError(
+            "export_bundle takes either coll+tables+dense_params (sparse "
+            "regime) or params (dense regime), not both/neither")
+    dtype = compute_dtype(mixed_precision, platform)
+    dtype_name = jnp.dtype(dtype).name
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+
+    arrays: dict[str, np.ndarray] = {}
+    manifest: dict[str, Any] = {
+        "bundle_version": BUNDLE_VERSION,
+        "kind": "sparse" if coll is not None else "dense",
+        "model": model,
+        "embed_dim": int(embed_dim),
+        "cat_columns": list(cat_columns),
+        "cont_columns": list(cont_columns),
+        "size_map": {k: int(v) for k, v in size_map.items()},
+        "step": int(step),
+        "dtype": dtype_name,
+    }
+    if coll is not None:
+        if tables is None or dense_params is None:
+            raise ValueError("sparse export needs tables and dense_params")
+        logical = merged_tables(coll, tables)
+        manifest["tables"] = {
+            n: [int(t.shape[0]), int(t.shape[1])] for n, t in logical.items()
+        }
+        for n, t in logical.items():
+            arrays[f"table:{n}"] = _store(t, dtype)
+        for k, v in _flatten(dense_params).items():
+            arrays[f"dense:{k}"] = _store(v, dtype)
+    else:
+        for k, v in _flatten(params).items():
+            arrays[f"params:{k}"] = _store(v, dtype)
+
+    np.savez(out / _ARRAYS, **arrays)
+    (out / _MANIFEST).write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    return out
+
+
+def load_bundle(bundle_dir: str | Path) -> ServingBundle:
+    """Load and VALIDATE a serving bundle; refuses anything suspect.
+
+    Refusal cases (each a ``ValueError`` naming the cause, mirroring the
+    training restore discipline): missing manifest, ``bundle_version``
+    mismatch, manifest/array key drift, and per-table shape drift — all of
+    which could otherwise serve scrambled or stale parameters silently.
+    """
+    bdir = Path(bundle_dir)
+    mpath = bdir / _MANIFEST
+    if not mpath.exists():
+        raise ValueError(f"{bdir} is not a serving bundle (no {_MANIFEST})")
+    manifest = json.loads(mpath.read_text())
+    found = manifest.get("bundle_version")
+    if found != BUNDLE_VERSION:
+        raise ValueError(
+            f"serving bundle {bdir} has bundle_version {found!r}, this build "
+            f"serves {BUNDLE_VERSION}.  The array schemas are not "
+            "value-compatible across versions; re-export the checkpoint.")
+    dtype_name = manifest["dtype"]
+    with np.load(bdir / _ARRAYS) as z:
+        arrays = {k: _load_stored(z[k], dtype_name) for k in z.files}
+
+    kind = manifest["kind"]
+    tables = dense_params = params = None
+    if kind == "sparse":
+        schema = manifest["tables"]
+        stored = {k.removeprefix("table:") for k in arrays if k.startswith("table:")}
+        if stored != set(schema):
+            raise ValueError(
+                f"serving bundle {bdir}: manifest tables {sorted(schema)} != "
+                f"stored arrays {sorted(stored)} — refusing a torn bundle")
+        tables = {}
+        for n, (rows, dim) in schema.items():
+            t = arrays[f"table:{n}"]
+            if t.shape != (rows, dim):
+                raise ValueError(
+                    f"serving bundle {bdir}: table {n!r} is {t.shape}, "
+                    f"manifest says {(rows, dim)} — refusing a torn bundle")
+            tables[n] = t
+        dense_params = _unflatten({
+            k.removeprefix("dense:"): v
+            for k, v in arrays.items() if k.startswith("dense:")
+        })
+    elif kind == "dense":
+        params = _unflatten({
+            k.removeprefix("params:"): v
+            for k, v in arrays.items() if k.startswith("params:")
+        })
+        if not params:
+            raise ValueError(f"serving bundle {bdir}: dense bundle holds no params")
+    else:
+        raise ValueError(f"serving bundle {bdir}: unknown kind {kind!r}")
+
+    return ServingBundle(
+        kind=kind,
+        model=manifest["model"],
+        embed_dim=int(manifest["embed_dim"]),
+        cat_columns=tuple(manifest["cat_columns"]),
+        cont_columns=tuple(manifest["cont_columns"]),
+        size_map={k: int(v) for k, v in manifest["size_map"].items()},
+        step=int(manifest["step"]),
+        dtype=dtype_name,
+        tables=tables,
+        dense_params=dense_params,
+        params=params,
+    )

@@ -1,0 +1,266 @@
+"""Micro-batching request frontend + the ``launch.py serve`` entry point.
+
+Online CTR traffic arrives as ragged little requests; TPU programs want a
+few fixed shapes.  :class:`MicroBatcher` bridges the two the way production
+serving stacks do (Monolith's serving tier, TF-Serving's batching layer):
+
+  * requests queue until ``max_batch`` rows are pending (ship full) or the
+    OLDEST request's ``batch_deadline_ms`` expires (ship partial — graceful
+    degradation: latency bounds beat utilisation, a stalled queue is worse
+    than a padded batch);
+  * every shipped batch pads up to the smallest of the configured power-of-
+    two ``buckets``, so the jit cache compiles AT MOST ``len(buckets)``
+    programs no matter how ragged the trace
+    (``tests/test_serve_frontend.py`` pins that count);
+  * per-request latency lands in the metrics JSONL via the existing
+    :class:`~tdfo_tpu.train.trainer.MetricLogger`, with a p50/p99 summary
+    record at the end — the observability layer the reference lacks.
+
+The clock is injectable so deadline behaviour is deterministic under test
+(the fault-injection stance of ``utils/faults.py`` applied to time).
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Callable, Mapping
+
+import numpy as np
+
+__all__ = ["MicroBatcher", "serve_from_config"]
+
+
+class MicroBatcher:
+    """Deadline/bucket batch assembly around one jitted ``score_fn``.
+
+    ``score_fn(batch) -> [B] scores`` must accept any batch size in
+    ``buckets`` (the scorer's jit retraces per shape — that is the whole
+    compile-count contract).  Requests are dicts of aligned ``[n]`` columns;
+    results come back unpadded, exactly ``n`` scores per request.
+    """
+
+    def __init__(
+        self,
+        score_fn: Callable,
+        *,
+        buckets: tuple[int, ...],
+        max_batch: int,
+        batch_deadline_ms: float,
+        logger=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        buckets = tuple(buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError("buckets must be non-empty, strictly increasing")
+        if max_batch > buckets[-1]:
+            raise ValueError(
+                f"max_batch {max_batch} does not fit buckets[-1] {buckets[-1]}")
+        self._score = score_fn
+        self._buckets = buckets
+        self._max_batch = int(max_batch)
+        self._deadline_s = float(batch_deadline_ms) / 1000.0
+        self._logger = logger
+        self._clock = clock
+        self._pending: list[tuple[Any, dict[str, np.ndarray], int, float]] = []
+        self._pending_rows = 0
+        self.results: dict[Any, np.ndarray] = {}
+        self.latencies_ms: list[float] = []
+        # (rows, padded) per shipped batch — the knob-observability hook:
+        # the bucket set changes `padded`, the deadline changes when a
+        # partial (rows < max_batch) batch ships
+        self.shipped: list[tuple[int, int]] = []
+
+    # ------------------------------------------------------------- intake
+
+    def submit(self, request_id: Any, batch: Mapping[str, np.ndarray]) -> None:
+        """Queue one request; ships (possibly several) full batches as soon
+        as ``max_batch`` rows are pending."""
+        cols = {k: np.asarray(v) for k, v in batch.items()}
+        n = len(next(iter(cols.values())))
+        if any(len(v) != n for v in cols.values()):
+            raise ValueError(f"request {request_id!r}: ragged columns")
+        if n > self._max_batch:
+            raise ValueError(
+                f"request {request_id!r} has {n} rows > max_batch "
+                f"{self._max_batch}; split it upstream")
+        self._pending.append((request_id, cols, n, self._clock()))
+        self._pending_rows += n
+        while self._pending_rows >= self._max_batch:
+            self._ship()
+
+    def poll(self) -> None:
+        """Ship a PARTIAL batch iff the oldest pending request's deadline
+        has expired (deadline 0 ships on every poll)."""
+        if not self._pending:
+            return
+        age = self._clock() - self._pending[0][3]
+        if age >= self._deadline_s:
+            self._ship()
+
+    def drain(self) -> None:
+        """Flush everything still pending (shutdown path)."""
+        while self._pending:
+            self._ship()
+
+    # ----------------------------------------------------------- shipping
+
+    def _bucket(self, rows: int) -> int:
+        for b in self._buckets:
+            if b >= rows:
+                return b
+        raise ValueError(
+            f"batch of {rows} rows exceeds buckets[-1] {self._buckets[-1]}")
+
+    def _ship(self) -> None:
+        take: list[tuple[Any, dict[str, np.ndarray], int, float]] = []
+        rows = 0
+        # whole requests only, first-come-first-served, up to max_batch
+        while self._pending and (
+                not take or rows + self._pending[0][2] <= self._max_batch):
+            item = self._pending.pop(0)
+            take.append(item)
+            rows += item[2]
+        self._pending_rows -= rows
+        padded = self._bucket(rows)
+        batch: dict[str, np.ndarray] = {}
+        for k in take[0][1]:
+            col = np.concatenate([cols[k] for _, cols, _, _ in take])
+            batch[k] = np.pad(col, [(0, padded - rows)] +
+                              [(0, 0)] * (col.ndim - 1))
+        scores = np.asarray(self._score(batch))[:rows]
+        self.shipped.append((rows, padded))
+        done = self._clock()
+        off = 0
+        for rid, _, n, t0 in take:
+            self.results[rid] = scores[off:off + n]
+            off += n
+            latency_ms = (done - t0) * 1000.0
+            self.latencies_ms.append(latency_ms)
+            if self._logger is not None:
+                self._logger.log(event="serve_request", request=str(rid),
+                                 rows=n, batch_rows=rows, padded=padded,
+                                 latency_ms=latency_ms)
+
+    # -------------------------------------------------------------- stats
+
+    def run(self, requests) -> dict[Any, np.ndarray]:
+        """Replay ``(request_id, batch)`` pairs through submit+poll, then
+        drain.  The trace-replay path tests and the serve command share."""
+        for rid, batch in requests:
+            self.submit(rid, batch)
+            self.poll()
+        self.drain()
+        return self.results
+
+    def stats(self) -> dict[str, float]:
+        lat = np.asarray(self.latencies_ms, np.float64)
+        out = {
+            "requests": int(lat.size),
+            "batches": len(self.shipped),
+            "p50_ms": float(np.percentile(lat, 50)) if lat.size else 0.0,
+            "p99_ms": float(np.percentile(lat, 99)) if lat.size else 0.0,
+        }
+        if self._logger is not None and lat.size:
+            self._logger.log(event="serve_summary", **out)
+        return out
+
+
+def serve_from_config(config, *, log_dir: str | Path | None = None,
+                      n_requests: int = 64) -> dict[str, Any]:
+    """The ``python -m tdfo_tpu.launch serve`` body: restore the newest
+    checkpoint (fresh init when none exists), export the serving bundle,
+    build the scorer, and run a synthetic ragged request trace through the
+    micro-batcher — plus, for TwoTower, a corpus build + one retrieval
+    round so every ``[serving]`` knob is exercised by the real command.
+    Returns the latency/throughput stats dict (printed by ``launch``)."""
+    import jax
+
+    from tdfo_tpu.serve.export import export_bundle, load_bundle
+    from tdfo_tpu.serve.scoring import make_scorer
+    from tdfo_tpu.train.trainer import Trainer, _ctr_columns
+
+    if config.model not in ("twotower", "dlrm"):
+        raise ValueError(
+            f"serve supports the CTR family (twotower/dlrm), not "
+            f"{config.model!r}")
+    trainer = Trainer(config, log_dir=log_dir)
+    state, step = trainer.state, 0
+    if trainer._ckpt is not None and trainer._ckpt.latest_step() is not None:
+        step, state, _ = trainer._ckpt.restore(
+            trainer.state, stamps=trainer._ckpt_stamps)
+
+    cat_cols, cont_cols = _ctr_columns(config)
+    out_dir = Path(log_dir or config.checkpoint_dir or ".") / "serving_bundle"
+    kwargs: dict[str, Any] = {}
+    if hasattr(state, "tables"):  # DMP/sparse regime
+        kwargs = dict(coll=trainer.coll, tables=state.tables,
+                      dense_params=state.dense_params)
+    else:
+        kwargs = dict(params=state.params)
+    export_bundle(
+        out_dir, model=config.model, embed_dim=config.embed_dim,
+        cat_columns=cat_cols, cont_columns=cont_cols,
+        size_map=config.size_map, step=step,
+        mixed_precision=config.mixed_precision, **kwargs)
+    bundle = load_bundle(out_dir)
+    scorer = make_scorer(bundle, mesh=trainer.mesh)
+
+    # synthetic ragged trace: ids within each vocab, floats in [0, 1)
+    vocab = _column_vocab(config, cat_cols)
+    rng = np.random.default_rng(config.seed)
+    spec = config.serving
+    hi = min(spec.max_batch, spec.buckets[0])
+    requests = []
+    for i in range(n_requests):
+        n = int(rng.integers(1, hi + 1))
+        batch: dict[str, np.ndarray] = {
+            c: rng.integers(0, vocab[c], size=n, dtype=np.int32)
+            for c in cat_cols
+        }
+        for c in cont_cols:
+            batch[c] = rng.random(n, dtype=np.float32)
+        requests.append((f"req{i}", batch))
+
+    t0 = time.monotonic()
+    mb = MicroBatcher(
+        scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
+        batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger)
+    mb.run(requests)
+    wall = time.monotonic() - t0
+    stats = mb.stats()
+    stats["qps"] = stats["requests"] / wall if wall > 0 else float("inf")
+    stats["programs"] = scorer.score_cache_size()
+    stats["bundle"] = str(out_dir)
+    stats["step"] = int(step)
+
+    if config.model == "twotower":
+        from tdfo_tpu.serve.corpus import build_corpus, synthetic_item_features
+        from tdfo_tpu.serve.retrieval import make_retrieval
+
+        n_items = int(config.size_map.get("item", 0))
+        if n_items > spec.top_k:
+            corpus = build_corpus(
+                scorer,
+                synthetic_item_features(config.size_map, n_items,
+                                        seed=config.seed),
+                corpus_batch=spec.corpus_batch, mesh=trainer.mesh)
+            retrieve = make_retrieval(
+                corpus, mesh=trainer.mesh, top_k=spec.top_k)
+            q_batch = {"user_id": np.arange(8, dtype=np.int32) %
+                       max(vocab.get("user_id", 1), 1)}
+            _, ids = retrieve(scorer.user_embed(q_batch))
+            stats["retrieved"] = int(jax.device_get(ids).shape[1])
+    trainer.logger.close()
+    return stats
+
+
+def _column_vocab(config, cat_cols) -> dict[str, int]:
+    """Vocab size per categorical INPUT column (the size_map keys by feature
+    for the TwoTower schema, by column for custom schemas)."""
+    if config.categorical_features:
+        return {c: int(config.size_map[c]) for c in cat_cols}
+    from tdfo_tpu.models.twotower import TWOTOWER_CATEGORICAL, _FEATURE_TO_INPUT
+
+    return {_FEATURE_TO_INPUT[f]: int(config.size_map[f])
+            for f in TWOTOWER_CATEGORICAL}

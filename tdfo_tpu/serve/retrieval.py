@@ -1,0 +1,135 @@
+"""Sharded EXACT top-k MIPS over the candidate corpus.
+
+On v5e the measured cost model makes brute force the right first retrieval
+subsystem (no ANN index): bf16 MXU matmuls run 100-350 us at Goodreads/
+Criteo corpus scales and ``lax.top_k``/argsort ~16 us, so a corpus-sharded
+scan saturates the chip — ScaNN's quantized search (Guo et al. 2020) only
+pays once corpora outgrow HBM.
+
+Program (one ``shard_map`` over the corpus shards, queries replicated):
+
+  1. per-shard ``[B, D] x [D, rows/shard]`` bf16 matmul with
+     ``preferred_element_type=f32`` (CLAUDE.md: bf16 INPUTS, f32
+     accumulation), padding rows (id -1) masked to -inf;
+  2. per-shard ``lax.top_k`` -> k local (score, id) candidates;
+  3. global merge: the ``k x n_shards`` candidates concatenate shard-major
+     and one final ``lax.top_k`` picks the answer.
+
+Bitwise-equal to :func:`retrieval_reference` (single-device stable argsort)
+including tie-breaks: ``lax.top_k`` prefers lower indices, which within a
+shard means lower corpus position, and the shard-major merge order means
+lower shard — i.e. lower corpus position globally — exactly the stable
+argsort's preference.  Scores pass through selection untouched, so they are
+the per-shard matmul's f32 bits.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tdfo_tpu.core.mesh import DATA_AXIS, shard_map
+from tdfo_tpu.serve.corpus import Corpus
+
+__all__ = ["make_retrieval", "mips_scores", "retrieval_reference"]
+
+
+def mips_scores(queries: jax.Array, vectors: jax.Array) -> jax.Array:
+    """THE serving score formula: ``[B, D] x [N, D] -> [B, N]`` f32 inner
+    products from bf16 operands.  One definition shared by the sharded
+    program and the reference so the bitwise-equality contract compares
+    identical arithmetic."""
+    return jax.lax.dot_general(
+        queries.astype(jnp.bfloat16),
+        vectors.astype(jnp.bfloat16),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _masked_top_k(scores: jax.Array, ids: jax.Array, k: int):
+    """Top-k over one corpus block, padding rows (id -1) masked to -inf so
+    shard-alignment padding can never be retrieved."""
+    scores = jnp.where(ids >= 0, scores, -jnp.inf)
+    s, pos = jax.lax.top_k(scores, k)
+    return s, jnp.take(ids, pos)
+
+
+def make_retrieval(
+    corpus: Corpus, *, mesh=None, axis: str = DATA_AXIS, top_k: int = 100
+) -> Callable[[jax.Array], tuple[jax.Array, jax.Array]]:
+    """Build the jitted retrieval program for one corpus.
+
+    Returns ``retrieve(queries[B, D]) -> (scores[B, k] f32, ids[B, k]
+    int32)``, candidates in descending score order.  The corpus rides as a
+    jit ARGUMENT (bound here), never a closure constant (CLAUDE.md: big
+    closed-over arrays serialize into the compile payload).  Without a mesh
+    the program degenerates to the single-device scan.
+    """
+    if top_k < 1:
+        raise ValueError("top_k must be >= 1")
+    if top_k > corpus.n_items:
+        raise ValueError(
+            f"top_k ({top_k}) exceeds the corpus ({corpus.n_items} items)")
+    n_shards = mesh.shape[axis] if mesh is not None else 1
+
+    if n_shards == 1:
+        @jax.jit
+        def retrieve_single(queries, vectors, ids):
+            return _masked_top_k(mips_scores(queries, vectors), ids, top_k)
+
+        return _bind(retrieve_single, corpus)
+
+    # a shard holds N_pad / n_shards rows; it can contribute at most that
+    # many candidates (k_local < top_k only for tiny corpora, where the
+    # merged k_local * n_shards >= N_pad >= top_k candidates still suffice)
+    k_local = min(top_k, corpus.vectors.shape[0] // n_shards)
+
+    def local(vec_shard, id_shard, queries):
+        return _masked_top_k(
+            mips_scores(queries, vec_shard), id_shard, k_local)
+
+    @jax.jit
+    def retrieve_sharded(queries, vectors, ids):
+        # out_specs concatenate the per-shard [B, k_local] candidate blocks
+        # along dim 1 SHARD-MAJOR — the property the tie-break proof needs
+        cand_s, cand_i = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(axis, None), P(axis), P()),
+            out_specs=(P(None, axis), P(None, axis)),
+            check_vma=False,
+        )(vectors, ids, queries)
+        top_s, pos = jax.lax.top_k(cand_s, top_k)
+        return top_s, jnp.take_along_axis(cand_i, pos, axis=1)
+
+    return _bind(retrieve_sharded, corpus)
+
+
+def _bind(jitted, corpus: Corpus):
+    """Close the corpus over a jitted ``(queries, vectors, ids)`` program as
+    jit ARGUMENTS; ``.jitted`` stays reachable for lowering inspection and
+    compile-cache accounting (``tests/test_serve_frontend.py``, bench)."""
+
+    def retrieve(queries):
+        return jitted(queries, corpus.vectors, corpus.ids)
+
+    retrieve.jitted = jitted
+    retrieve.corpus = corpus
+    return retrieve
+
+
+def retrieval_reference(
+    queries, corpus: Corpus, *, top_k: int = 100
+) -> tuple[jax.Array, jax.Array]:
+    """Single-device exact reference: full matmul + STABLE argsort (ties ->
+    lowest corpus position, the same preference ``lax.top_k`` encodes).
+    The bitwise yardstick for :func:`make_retrieval` — ids AND f32 scores."""
+    vectors = jnp.asarray(jax.device_get(corpus.vectors))[:corpus.n_items]
+    ids = jnp.asarray(jax.device_get(corpus.ids))[:corpus.n_items]
+    scores = mips_scores(jnp.asarray(queries), vectors)  # [B, N]
+    order = jnp.argsort(-scores, axis=-1, stable=True)[:, :top_k]
+    return jnp.take_along_axis(scores, order, axis=1), jnp.take(ids, order)

@@ -236,3 +236,86 @@ def test_grouped_a2a_knob_validation():
     Config(train=TrainSpec(pipeline_overlap=True),
            embeddings=EmbeddingsSpec(grouped_a2a=True),
            lookup_mode="alltoall", model_parallel=True)
+
+
+def test_serving_table(tmp_path: Path):
+    """The [serving] section maps onto ServingSpec; unknown keys rejected,
+    buckets land as a tuple."""
+    cfg = read_configs()
+    assert cfg.serving.top_k == 100
+    assert cfg.serving.buckets == (256, 1024, 8192)
+    (tmp_path / "config.toml").write_text(
+        "[serving]\ntop_k = 10\ncorpus_batch = 512\nmax_batch = 64\n"
+        "batch_deadline_ms = 2.5\nbuckets = [16, 64]\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.serving.top_k == 10
+    assert cfg.serving.corpus_batch == 512
+    assert cfg.serving.max_batch == 64
+    assert cfg.serving.batch_deadline_ms == 2.5
+    assert cfg.serving.buckets == (16, 64)
+    (tmp_path / "config.toml").write_text("[serving]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="bogus"):
+        read_configs(tmp_path / "config.toml")
+
+
+def test_serving_knob_validation():
+    from tdfo_tpu.core.config import ServingSpec
+
+    for bad, match in (
+        (dict(top_k=0), "top_k"),
+        (dict(corpus_batch=0), "corpus_batch"),
+        (dict(max_batch=0), "max_batch"),
+        (dict(batch_deadline_ms=-1.0), "batch_deadline_ms"),
+        (dict(buckets=()), "buckets"),
+        (dict(buckets=(8, 8)), "strictly increasing"),
+        (dict(buckets=(32, 8)), "strictly increasing"),
+        (dict(buckets=(0, 8)), "buckets"),
+        (dict(max_batch=64, buckets=(8, 32)), "max_batch"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(serving=ServingSpec(**bad))
+    Config(serving=ServingSpec(top_k=1, max_batch=8, buckets=(8,),
+                               batch_deadline_ms=0.0))
+
+
+def test_serving_knobs_observable():
+    """Every [serving] key changes observable behaviour: the bucket set
+    changes shipped padding, the deadline changes when partials ship, and
+    max_batch changes when full batches ship."""
+    import numpy as np
+
+    from tdfo_tpu.serve.frontend import MicroBatcher
+
+    score = lambda b: np.asarray(b["x"], np.float32)
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    trace = [(i, {"x": np.arange(5)}) for i in range(2)]
+    for buckets, padded in (((8, 64), 8), ((16, 64), 16)):
+        mb = MicroBatcher(score, buckets=buckets, max_batch=64,
+                          batch_deadline_ms=0.0, clock=Clock())
+        mb.run(trace)
+        assert {p for _, p in mb.shipped} == {padded}
+
+    # deadline: 5 ms holds a 4 ms-old partial that 3 ms would have shipped
+    for deadline, ships in ((3.0, True), (5.0, False)):
+        clk = Clock()
+        mb = MicroBatcher(score, buckets=(8,), max_batch=8,
+                          batch_deadline_ms=deadline, clock=clk)
+        mb.submit("r", {"x": np.arange(2)})
+        clk.t = 0.004
+        mb.poll()
+        assert bool(mb.shipped) is ships
+
+    # max_batch: the same trace ships full at 8 rows vs waits at 16
+    for max_batch, batches in ((8, 2), (16, 1)):
+        mb = MicroBatcher(score, buckets=(16,), max_batch=max_batch,
+                          batch_deadline_ms=1e9, clock=Clock())
+        for i in range(4):
+            mb.submit(i, {"x": np.arange(4)})
+        mb.drain()
+        assert len(mb.shipped) == batches

@@ -1,0 +1,219 @@
+"""Micro-batching frontend tests: compile counts, deadlines, observability.
+
+The frontend's three contracts:
+
+  * COMPILE budget — an arbitrarily ragged request trace pads to the fixed
+    bucket set, so the scoring jit cache holds at most ``len(buckets)``
+    programs (the TPU analogue of TF-Serving's allowed_batch_sizes);
+  * DEADLINE semantics — a partial batch ships exactly when the OLDEST
+    pending request's deadline expires (graceful degradation), results come
+    back correctly UNPADDED per request;
+  * OBSERVABILITY — per-request latency lands in the metrics JSONL plus a
+    p50/p99 summary record.
+
+Serving programs must also stay scatter-free (CLAUDE.md: ~170 ns/row on
+v5e); the lowering-text checks pin that for scoring AND retrieval.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tdfo_tpu.serve.frontend import MicroBatcher
+from tdfo_tpu.train.trainer import MetricLogger
+
+
+class FakeClock:
+    """Injectable monotonic time — deadline tests must not sleep."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, s):
+        self.t += s
+
+    def __call__(self):
+        return self.t
+
+
+def _counting_score():
+    """A scoring stub that records every batch shape it is traced with."""
+    shapes = []
+
+    def score(batch):
+        x = np.asarray(batch["x"], np.float32)
+        shapes.append(x.shape[0])
+        return x * 2.0
+
+    return score, shapes
+
+
+# ----------------------------------------------------------- batching core
+
+
+def test_full_batches_ship_immediately():
+    score, shapes = _counting_score()
+    mb = MicroBatcher(score, buckets=(8, 32), max_batch=32,
+                      batch_deadline_ms=1e9, clock=FakeClock())
+    for i in range(8):
+        mb.submit(i, {"x": np.full(8, i)})
+    # 32-row batches shipped as soon as they filled, nothing waited on time
+    assert mb.shipped == [(32, 32), (32, 32)]
+    for i in range(8):
+        np.testing.assert_array_equal(mb.results[i], np.full(8, 2.0 * i))
+
+
+def test_deadline_ships_partial_and_unpads():
+    score, _ = _counting_score()
+    clk = FakeClock()
+    mb = MicroBatcher(score, buckets=(8, 32), max_batch=32,
+                      batch_deadline_ms=5.0, clock=clk)
+    mb.submit("a", {"x": np.arange(3)})
+    clk.advance(0.004)
+    mb.poll()
+    assert mb.shipped == [] and "a" not in mb.results  # deadline not hit
+    clk.advance(0.002)
+    mb.poll()
+    assert mb.shipped == [(3, 8)]  # partial batch, padded 3 -> bucket 8
+    np.testing.assert_array_equal(mb.results["a"], np.arange(3) * 2.0)
+    assert mb.results["a"].shape == (3,)  # unpadded result
+
+    # deadline 0: every poll ships whatever is pending
+    mb0 = MicroBatcher(score, buckets=(8,), max_batch=8,
+                       batch_deadline_ms=0.0, clock=clk)
+    mb0.submit("b", {"x": np.arange(2)})
+    mb0.poll()
+    assert mb0.shipped == [(2, 8)]
+
+
+def test_deadline_is_oldest_request():
+    """A young request cannot reset the clock for an old one."""
+    score, _ = _counting_score()
+    clk = FakeClock()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8,
+                      batch_deadline_ms=5.0, clock=clk)
+    mb.submit("old", {"x": np.arange(2)})
+    clk.advance(0.004)
+    mb.submit("young", {"x": np.arange(2)})
+    clk.advance(0.002)  # old is 6 ms stale, young only 2 ms
+    mb.poll()
+    assert mb.shipped == [(4, 8)]  # both ride the ship old triggered
+    assert set(mb.results) == {"old", "young"}
+
+
+def test_bucket_knob_changes_padding():
+    """Same trace, different bucket sets -> different padded shapes (the
+    [serving].buckets observability hook)."""
+    trace = [(i, {"x": np.arange(5)}) for i in range(3)]
+    for buckets, expect in [((8, 16), 8), ((6, 16), 6), ((16,), 16)]:
+        score, shapes = _counting_score()
+        mb = MicroBatcher(score, buckets=buckets, max_batch=buckets[-1],
+                          batch_deadline_ms=0.0, clock=FakeClock())
+        mb.run(trace)
+        assert all(p == expect for _, p in mb.shipped)
+        assert set(shapes) == {expect}
+
+
+def test_validation():
+    score, _ = _counting_score()
+    with pytest.raises(ValueError, match="strictly increasing"):
+        MicroBatcher(score, buckets=(8, 8), max_batch=8, batch_deadline_ms=1)
+    with pytest.raises(ValueError, match="strictly increasing"):
+        MicroBatcher(score, buckets=(), max_batch=8, batch_deadline_ms=1)
+    with pytest.raises(ValueError, match="does not fit"):
+        MicroBatcher(score, buckets=(8,), max_batch=16, batch_deadline_ms=1)
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=1)
+    with pytest.raises(ValueError, match="ragged columns"):
+        mb.submit("r", {"x": np.arange(3), "y": np.arange(4)})
+    with pytest.raises(ValueError, match="split it upstream"):
+        mb.submit("r", {"x": np.arange(9)})
+
+
+def test_latency_jsonl(tmp_path):
+    """Per-request records + the p50/p99 summary land in metrics.jsonl."""
+    logger = MetricLogger(tmp_path)
+    score, _ = _counting_score()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=0.0,
+                      logger=logger, clock=FakeClock())
+    mb.run([(f"r{i}", {"x": np.arange(2)}) for i in range(4)])
+    stats = mb.stats()
+    logger.close()
+    records = [json.loads(l) for l in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    reqs = [r for r in records if r.get("event") == "serve_request"]
+    assert [r["request"] for r in reqs] == ["r0", "r1", "r2", "r3"]
+    assert all(r["rows"] == 2 and r["padded"] == 8 for r in reqs)
+    summary = [r for r in records if r.get("event") == "serve_summary"]
+    assert len(summary) == 1 and summary[0]["requests"] == 4
+    assert stats["requests"] == 4 and stats["batches"] == 4
+    assert stats["p99_ms"] >= stats["p50_ms"] >= 0.0
+
+
+# ------------------------------------------------- compile-count regression
+
+
+@pytest.fixture(scope="module")
+def scorer8(mesh8, tmp_path_factory):
+    """A real sparse TwoTower scorer on the 8-device mesh (module-scoped:
+    the compile-count test needs a FRESH jit cache, so it builds its own)."""
+    from tests.test_serve import _export_sparse, _twotower_sparse
+    from tdfo_tpu.serve.export import load_bundle
+    from tdfo_tpu.serve.scoring import make_scorer
+
+    coll, _, state = _twotower_sparse(mesh8)
+    out = _export_sparse(tmp_path_factory.mktemp("bundle") / "b", coll, state)
+    return make_scorer(load_bundle(out), mesh=mesh8)
+
+
+def test_ragged_trace_compiles_at_most_len_buckets(scorer8):
+    """40 requests of 17 distinct sizes pad to 3 buckets -> the scoring jit
+    cache holds <= 3 programs.  THE compile-budget regression bar."""
+    from tests.test_serve import _ctr_batch
+
+    buckets = (8, 32, 64)
+    assert scorer8.score_cache_size() == 0
+    rng = np.random.default_rng(0)
+    trace = [(i, _ctr_batch(rng, int(rng.integers(1, 65)), with_label=False))
+             for i in range(40)]
+    mb = MicroBatcher(scorer8.score, buckets=buckets, max_batch=64,
+                      batch_deadline_ms=0.0, clock=FakeClock())
+    mb.run(trace)
+    assert len({r for r, _ in mb.shipped}) > len(buckets)  # genuinely ragged
+    assert {p for _, p in mb.shipped} <= set(buckets)
+    assert scorer8.score_cache_size() <= len(buckets)
+    for i, batch in trace:
+        assert mb.results[i].shape == (len(batch["user_id"]),)
+
+
+def test_serving_programs_are_scatter_free(scorer8, mesh8):
+    """No serving program may lower a scatter (CLAUDE.md: ~170 ns/row):
+    scoring, both towers, corpus chunks, and sharded retrieval."""
+    from tests.test_serve import SIZE_MAP, _ctr_batch
+    from tdfo_tpu.serve.corpus import build_corpus, synthetic_item_features
+    from tdfo_tpu.serve.retrieval import make_retrieval, mips_scores
+
+    batch = _ctr_batch(np.random.default_rng(1), 8, with_label=False)
+    lowered = scorer8._score.lower(dict(batch), *scorer8._params)
+    assert "scatter" not in lowered.as_text()
+
+    lowered = scorer8._user.lower(dict(batch), *scorer8._params)
+    assert "scatter" not in lowered.as_text()
+    lowered = scorer8._item.lower(dict(batch), *scorer8._params)
+    assert "scatter" not in lowered.as_text()
+
+    corpus = build_corpus(
+        scorer8, synthetic_item_features(SIZE_MAP, 64, seed=0),
+        corpus_batch=64, mesh=mesh8)
+    queries = jnp.zeros((4, 16), jnp.float32)
+    retrieve = make_retrieval(corpus, mesh=mesh8, top_k=10)
+    text = retrieve.jitted.lower(
+        queries, corpus.vectors, corpus.ids).as_text()
+    assert "scatter" not in text
+    assert "scatter" not in jax.jit(mips_scores).lower(
+        queries, corpus.vectors).as_text()
+    s, ids = retrieve(queries)
+    assert s.shape == (4, 10) and ids.shape == (4, 10)
