@@ -389,7 +389,8 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int,
 
 
 def build_sparse_train_bench(batch_size: int, embed_dim: int,
-                             model: str = "twotower"):
+                             model: str = "twotower",
+                             table_dtype: str = "float32"):
     """HEADLINE: the DMP regime — ShardedEmbeddingCollection + row-sparse
     in-backward Adam (``make_sparse_train_step``), the torchrec
     ``DistributedModelParallel`` + fused-optimizer equivalent.  ``model``
@@ -416,10 +417,17 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int,
     platform = jax.devices()[0].platform
     dtype = jnp.bfloat16 if platform != "cpu" else jnp.float32
     mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
-    coll = ShardedEmbeddingCollection(
-        ctr_embedding_specs(SIZE_MAP, embed_dim, "row"), mesh=mesh
-    )
+    specs = ctr_embedding_specs(SIZE_MAP, embed_dim, "row")
+    if table_dtype != "float32":
+        # quantized STORAGE (bf16 tables + stochastic-rounding writes);
+        # compute stays f32 either way, so the step program only differs by
+        # the storage width and the SR key threading
+        import dataclasses as _dc
+
+        specs = [_dc.replace(s, dtype=jnp.dtype(table_dtype)) for s in specs]
+    coll = ShardedEmbeddingCollection(specs, mesh=mesh)
     tables = coll.init(jax.random.key(0))
+    table_bytes = int(sum(t.nbytes for t in tables.values()))
     if model == "dlrm":
         from tdfo_tpu.models.dlrm import DLRMBackbone
 
@@ -470,14 +478,18 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int,
     dense_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(dense))
     flops_per_example = dense_flops_per_example(dense)
 
+    t_item = jnp.dtype(table_dtype).itemsize
+
     def floor_bytes_fn() -> float:
         # sparse Adam read-modify-writes table/mu/nu rows for touched rows
-        # only: 6 buffers x U x D x 4B, U measured per step above; dense
-        # params still pay the full 6x dense AdamW sweep (they're tiny).
+        # only: table rows at the STORAGE dtype width (read + write), mu/nu
+        # slots at f32 (4 passes), U measured per step above; dense params
+        # still pay the full 6x dense AdamW sweep (they're tiny).
         u_mean = float(np.mean(unique_rows_per_step)) if unique_rows_per_step else 0.0
-        return 6.0 * u_mean * embed_dim * 4.0 + 6.0 * dense_bytes
+        per_row = 2.0 * t_item + 4.0 * 4.0
+        return per_row * u_mean * embed_dim + 6.0 * dense_bytes
 
-    return run, make_args, b, floor_bytes_fn, flops_per_example
+    return run, make_args, b, floor_bytes_fn, flops_per_example, table_bytes
 
 
 def bench_embedding_lookup(batch_size: int = 8192, vocab: int = 2_000_000,
@@ -807,6 +819,12 @@ def main() -> None:
                          "the BASELINE.json north-star workload: 26 "
                          "Criteo-Kaggle tables, 33.76M rows, stacked, "
                          "rowwise-adagrad)")
+    ap.add_argument("--table-dtype", default="float32",
+                    choices=["float32", "bfloat16"],
+                    help="twotower/dlrm sparse headline only: embedding "
+                         "STORAGE dtype (bfloat16 = quantized tables with "
+                         "stochastic-rounding writes; halves table HBM and "
+                         "optimizer row traffic, compute stays f32)")
     ap.add_argument("--skip-big-table", action="store_true")
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving-path records (serve_score8 / "
@@ -829,10 +847,14 @@ def main() -> None:
         ap.error("--model is only valid for the sparse headline (drop --dense)")
     if (args.hot_vocab or args.powerlaw) and args.model != "dlrm-criteo":
         ap.error("--hot-vocab/--powerlaw require --model dlrm-criteo")
+    if args.table_dtype != "float32" and (
+            args.dense or args.model == "dlrm-criteo"):
+        ap.error("--table-dtype applies to the twotower/dlrm sparse headline")
 
     import jax
 
     hot_info = None
+    table_bytes = None
     if args.dense:
         run, make_args, global_batch, floor_bytes, flops_per_ex = build_train_bench(
             args.batch_size, args.embed_dim
@@ -844,8 +866,9 @@ def main() -> None:
                                      powerlaw=args.powerlaw)
         )
     else:
-        run, make_args, global_batch, floor_bytes, flops_per_ex = (
-            build_sparse_train_bench(args.batch_size, args.embed_dim, args.model)
+        run, make_args, global_batch, floor_bytes, flops_per_ex, table_bytes = (
+            build_sparse_train_bench(args.batch_size, args.embed_dim,
+                                     args.model, args.table_dtype)
         )
     sec_per_step = chain_time(run, make_args)
     if callable(floor_bytes):  # sparse floor depends on the generated batches
@@ -908,6 +931,10 @@ def main() -> None:
         # speedup over the uniform-traffic baseline record
         bench_config["hot_vocab"] = args.hot_vocab
         bench_config["powerlaw"] = True
+    if args.table_dtype != "float32":
+        # quantized storage changes the per-step byte budget: gate
+        # vs_baseline so a bf16 run never claims a speedup over f32
+        bench_config["table_dtype"] = args.table_dtype
     record = {
         "metric": f"{model_name.replace('-', '_')}_train_examples_per_sec_per_chip",
         "value": round(examples_per_sec_per_chip, 1),
@@ -915,6 +942,10 @@ def main() -> None:
         "regime": "dense_adamw" if args.dense else "dmp_sparse",
         "step_ms": round(sec_per_step * 1e3, 3),
         "roofline_floor_ms": round(floor_sec * 1e3, 3),
+        # storage/traffic at the table STORAGE dtype: bf16 halves
+        # table_bytes and the table share of bytes_per_step
+        "table_bytes": table_bytes,
+        "bytes_per_step": round(floor_bytes, 1),
         "hbm_utilization": round(hbm_util, 3),
         "mfu": round(mfu, 5),
         "embedding_lookup_p50_us": lookup,
@@ -924,6 +955,14 @@ def main() -> None:
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,
     }
+    if args.table_dtype == "bfloat16":
+        # the quantized-storage record: same workload as the f32 headline,
+        # half the table HBM — compare step_ms against the f32 run directly
+        record["quant_bf16"] = {
+            "table_bytes": table_bytes,
+            "bytes_per_step": round(floor_bytes, 1),
+            "step_ms": round(sec_per_step * 1e3, 3),
+        }
     if hot_info is not None and (hot_info["enabled"] or hot_info["powerlaw"]):
         record["hot_cold"] = {
             "enabled": hot_info["enabled"],

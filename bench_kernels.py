@@ -125,6 +125,65 @@ def bench_fat_adam(v: int = 2_000_000, d: int = 64, b: int = 8192) -> dict:
     }
 
 
+def bench_fat_bf16(v: int = 2_000_000, d: int = 64, b: int = 8192) -> dict:
+    """Quantized fat-line storage ablation: bf16 packed lines (half the
+    per-line DMA bytes, in-kernel stochastic-rounding writeback keyed per
+    step) vs the f32 fat tier on identical updates.  vs_baseline > 1 means
+    bf16 wins — expect roughly the DMA-byte ratio at this profile, since
+    the fat tier is line-traffic-bound (docs/BUDGET.md)."""
+    from tdfo_tpu.ops.pallas_kernels import fat_pack
+    from tdfo_tpu.ops.quant import sr_key as make_sr_key
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer("adam", lr=1e-2, small_vocab_threshold=0)
+    probe = jax.random.normal(jax.random.key(9), (d,))
+
+    def build(dtype):
+        quant = dtype != jnp.float32
+
+        def run(k):
+            @jax.jit
+            def chain(key, ids_stack, grads_stack):
+                table = jax.random.uniform(key, (v, d), jnp.float32)
+                fat = fat_pack(table, jnp.zeros((v, d), jnp.float32),
+                               jnp.zeros((v, d), jnp.float32), dtype=dtype)
+                slots = opt.init(fat)
+
+                def body(carry, xs):
+                    t, s, step = carry
+                    ids, g = xs
+                    sk = make_sr_key(step, "bench_fat") if quant else None
+                    t, s = opt.update(t, s, ids, g, embedding_dim=d,
+                                      sr_key=sk)
+                    return (t, s, step + 1), None
+
+                (t, _, _), _ = jax.lax.scan(
+                    body, (fat, slots, jnp.int32(0)),
+                    (ids_stack, grads_stack))
+                return (t[0, 0, :d].astype(jnp.float32) @ probe).sum()
+
+            return chain
+
+        return run
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        ids = jax.device_put(r.integers(0, v, (k, b)).astype(np.int32))
+        grads = jax.device_put(r.standard_normal((k, b, d), np.float32))
+        float(jnp.sum(ids) + jnp.sum(grads))
+        return (jax.random.key(seed), ids, grads)
+
+    bf16_sec = _chain_time(build(jnp.bfloat16), make_args)
+    f32_sec = _chain_time(build(jnp.float32), make_args)
+    return {
+        "metric": f"fat_adam_bf16_V{v}_B{b}_D{d}_ms",
+        "value": round(bf16_sec * 1e3, 3),
+        "unit": "ms",
+        "f32_fat_ms": round(f32_sec * 1e3, 3),
+        "vs_baseline": round(f32_sec / max(bf16_sec, 1e-9), 3),  # >1 = bf16 faster
+    }
+
+
 def bench_hot_cold_update(v: int = 10_131_227, d: int = 16, b: int = 8192,
                           k_hot: int = 16_384) -> dict:
     """Frequency-partitioned update ablation at the Criteo big-table profile
@@ -296,5 +355,6 @@ if __name__ == "__main__":
     print(json.dumps(bench_flash()))
     print(json.dumps(bench_flash_bwd()))
     print(json.dumps(bench_fat_adam()))
+    print(json.dumps(bench_fat_bf16()))
     print(json.dumps(bench_hot_cold_update()))
     print(json.dumps(bench_ring_flash()))

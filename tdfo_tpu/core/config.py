@@ -97,6 +97,40 @@ class EmbeddingsSpec:
     # exchange.  Requires lookup_mode = "alltoall" + model_parallel; losses
     # are bit-identical to the per-table program.
     grouped_a2a: bool = False
+    # STORAGE dtype of every embedding table in the DMP regime (fbgemm
+    # quantized/mixed-precision TBE parity): "bfloat16" halves table HBM,
+    # fat-line DMA bytes, and the grouped-a2a vector/grad payloads.  Compute
+    # stays f32 — reads widen the small gathered block after the row gather,
+    # writes requantize with stochastic rounding keyed on (step, table_id)
+    # (ops/quant.py), so training stays bit-deterministic and
+    # resume-identical.  "float32" (default) is byte-identical to the
+    # unquantized storage layer.
+    table_dtype: str = "float32"
+    # STORAGE dtype of the Adam/Adagrad slot buffers of PLAIN (non-fused)
+    # tables.  Fused fat-line tables pack their optimizer state into the
+    # same lines as the rows, so their state width follows table_dtype.
+    # rowwise_adagrad keeps its ONE f32 accumulator per row regardless
+    # (fbgemm EXACT_ROWWISE_ADAGRAD parity contract) — bf16 slots with that
+    # kind are rejected.
+    slot_dtype: str = "float32"
+    # per-table table_dtype overrides: a [embeddings.table_dtype_overrides]
+    # toml sub-table mapping table name -> dtype string.  Tables not listed
+    # use table_dtype.  Normalised to a sorted tuple of (name, dtype) pairs
+    # so the Config stays hashable.
+    table_dtype_overrides: Any = ()
+
+    def __post_init__(self) -> None:
+        ov = self.table_dtype_overrides
+        if isinstance(ov, Mapping):
+            ov = sorted(ov.items())
+        object.__setattr__(
+            self, "table_dtype_overrides",
+            tuple((str(k), str(v)) for k, v in ov))
+
+    def dtype_for(self, table_name: str) -> str:
+        """Effective storage-dtype string for ``table_name``."""
+        return dict(self.table_dtype_overrides).get(
+            table_name, self.table_dtype)
 
 
 @dataclass(frozen=True)
@@ -384,6 +418,32 @@ class Config:
         if self.sparse_optimizer not in ("adam", "sgd", "adagrad",
                                          "rowwise_adagrad"):
             raise ValueError(f"unknown sparse_optimizer: {self.sparse_optimizer!r}")
+        _storage_dtypes = ("float32", "bfloat16")
+        emb = self.embeddings
+        for label, dt in (("table_dtype", emb.table_dtype),
+                          ("slot_dtype", emb.slot_dtype),
+                          *((f"table_dtype_overrides[{n!r}]", d)
+                            for n, d in emb.table_dtype_overrides)):
+            if dt not in _storage_dtypes:
+                raise ValueError(
+                    f"embeddings {label} must be one of {_storage_dtypes}, "
+                    f"got {dt!r}")
+        if (emb.slot_dtype == "bfloat16"
+                and self.sparse_optimizer == "rowwise_adagrad"):
+            raise ValueError(
+                'slot_dtype = "bfloat16" cannot combine with '
+                'sparse_optimizer = "rowwise_adagrad": that kind stores ONE '
+                "f32 accumulator per row (the fbgemm EXACT_ROWWISE_ADAGRAD "
+                "parity contract), so quantizing the slot buffer is refused")
+        if (emb.table_dtype != "float32" or emb.slot_dtype != "float32"
+                or any(d != "float32"
+                       for _, d in emb.table_dtype_overrides)):
+            if not (self.model == "dlrm"
+                    or (self.model == "twotower" and self.model_parallel)):
+                raise ValueError(
+                    "embeddings table_dtype/slot_dtype configure the DMP "
+                    "sparse regime (dlrm, or twotower with model_parallel "
+                    "= true); other regimes would silently ignore the knob")
         if self.steps_per_execution < 1:
             raise ValueError("steps_per_execution must be >= 1")
         if self.checkpoint_every_n_steps < 0:

@@ -79,8 +79,12 @@ def _flash_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_
         acc, m, l = carry
         k_blk = k_ref[pl.ds(kt * block_k, block_k), :]
         v_blk = v_ref[pl.ds(kt * block_k, block_k), :]
+        # DEFAULT precision is INTENDED on the flash dots (bf16 operands on
+        # the MXU); stated explicitly because the quality gate rejects
+        # precision-less dot_general in this file
         s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )  # [BQ, BK] f32
         valid = valid_ref[0, pl.ds(kt * block_k, block_k)] > 0  # [BK]
         s = jnp.where(valid[None, :], s, _NEG_INF)
@@ -93,6 +97,7 @@ def _flash_kernel(valid_ref, q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_
         acc_new = acc * corr + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )
         return acc_new, m_new, l_new
 
@@ -229,18 +234,21 @@ def _flash_bwd_dq_kernel(valid_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         k_blk = k_ref[pl.ds(kt * block_k, block_k), :]
         v_blk = v_ref[pl.ds(kt * block_k, block_k), :]
         s = scale * jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )
         valid = valid_ref[0, pl.ds(kt * block_k, block_k)] > 0
         # p = softmax prob reconstructed; exp(-inf)=0 kills masked keys and
         # fully-masked rows (lse = +inf) alike
         p = jnp.exp(jnp.where(valid[None, :], s, _NEG_INF) - lse)
         dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )
         ds = (p * (dp - delta)).astype(k_blk.dtype)
         return acc + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )
 
     acc = jax.lax.fori_loop(0, t // block_k, body, jnp.zeros((bq, dh), jnp.float32))
@@ -264,19 +272,23 @@ def _flash_bwd_dkv_kernel(valid_ref, lse_ref, delta_ref, q_ref, k_ref, v_ref,
         lse = lse_ref[0, pl.ds(qt * block_q, block_q)].astype(jnp.float32)[:, None]
         delta = delta_ref[0, pl.ds(qt * block_q, block_q)].astype(jnp.float32)[:, None]
         s = scale * jax.lax.dot_general(
-            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q_blk, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )  # [BQ, BK]
         p = jnp.exp(jnp.where(valid[None, :], s, _NEG_INF) - lse)
         dv_acc = dv_acc + jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )  # [BK, Dh]
         dp = jax.lax.dot_general(
-            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do_blk, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )  # [BQ, BK]
         ds = (p * (dp - delta)).astype(q_blk.dtype)
         dk_acc = dk_acc + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            ds, q_blk, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.DEFAULT,
         )  # [BK, Dh]
         return dk_acc, dv_acc
 
@@ -487,31 +499,39 @@ def fat_gather_rows(fat: jax.Array, ids: jax.Array, layout: LineLayout) -> jax.A
 
 
 def fat_pack(table: jax.Array, *state: jax.Array, kind: str = "adam",
-             layout: LineLayout | None = None) -> jax.Array:
+             layout: LineLayout | None = None, dtype=None) -> jax.Array:
     """[V, d] table (+ per-kind optimizer state) -> [L, T, 128] fat lines.
 
     State arguments by kind: adam ``(mu[V,d], nu[V,d])``; adagrad
     ``(accum[V,d],)``; rowwise_adagrad ``(accum[V],)``; sgd none.  Missing
     state defaults to zeros (fresh init).  Padding rows/lanes are zero.
+
+    ``dtype`` is the STORAGE dtype of the packed lines (default: the
+    table's own dtype).  Fat lines interleave table and state lanes in one
+    buffer, so the whole line shares it — a bf16 line halves the DMA bytes
+    but packs the optimizer state at bf16 too, which is why fused
+    rowwise_adagrad (f32-per-row accumulator contract) rejects bf16
+    upstream (``parallel/embedding.py``).
     """
     v, d = table.shape
     lay = layout or line_layout(d, kind)
+    dt = jnp.dtype(dtype) if dtype is not None else table.dtype
     want = {"sgd": 0, "rowwise_adagrad": 1, "adagrad": 1, "adam": 2}[lay.kind]
     if state and len(state) != want:
         raise ValueError(f"{lay.kind} fat_pack takes {want} state arrays")
-    comps = [table.astype(jnp.float32)]
+    comps = [table.astype(dt)]
     if lay.kind == "rowwise_adagrad":
-        acc = state[0] if state else jnp.zeros((v,), jnp.float32)
-        comps.append(acc.astype(jnp.float32)[:, None])
+        acc = state[0] if state else jnp.zeros((v,), dt)
+        comps.append(acc.astype(dt)[:, None])
     elif lay.kind == "adagrad":
-        acc = state[0] if state else jnp.zeros((v, d), jnp.float32)
-        comps.append(acc.astype(jnp.float32))
+        acc = state[0] if state else jnp.zeros((v, d), dt)
+        comps.append(acc.astype(dt))
     elif lay.kind == "adam":
-        mu = state[0] if state else jnp.zeros((v, d), jnp.float32)
-        nu = state[1] if len(state) > 1 else jnp.zeros((v, d), jnp.float32)
-        comps += [mu.astype(jnp.float32), nu.astype(jnp.float32)]
+        mu = state[0] if state else jnp.zeros((v, d), dt)
+        nu = state[1] if len(state) > 1 else jnp.zeros((v, d), dt)
+        comps += [mu.astype(dt), nu.astype(dt)]
     if lay.w > lay.need:
-        comps.append(jnp.zeros((v, lay.w - lay.need), jnp.float32))
+        comps.append(jnp.zeros((v, lay.w - lay.need), dt))
     rows = comps[0] if len(comps) == 1 else jnp.concatenate(comps, axis=1)
     pad = lay.padded_rows(v) - v
     rows = jnp.pad(rows, ((0, pad), (0, 0)))
@@ -584,14 +604,20 @@ def _line_math(x, gp, tl, corr, layout: LineLayout, *, lr, b1, b2, eps,
     bit-compatible with the XLA row formulations in ``ops.sparse`` (same
     order of operations; the only divergence is matmul vs reduce summation
     order in cross-lane sums).
+
+    ``x`` may arrive at the narrow STORAGE dtype (bf16 fat lines); all math
+    runs f32 — the widening below is an identity op for f32 inputs, and the
+    caller requantizes the returned f32 block (:func:`_sr_writeback`).
     """
     t_tiles, w, d, kind = layout.tiles, layout.w, layout.d, layout.kind
     rows = x.shape[0]
     wd = weight_decay
-    xs = [x[:, t, :] for t in range(t_tiles)]
+    xs = [x[:, t, :].astype(jnp.float32) for t in range(t_tiles)]
     # gp/tl accept per-tile LISTS (kernel paths that build them in VMEM)
-    gs = gp if isinstance(gp, list) else [gp[:, t, :] for t in range(t_tiles)]
-    ts = tl if isinstance(tl, list) else [tl[:, t, :] for t in range(t_tiles)]
+    gs = gp if isinstance(gp, list) else [gp[:, t, :].astype(jnp.float32)
+                                         for t in range(t_tiles)]
+    ts = tl if isinstance(tl, list) else [tl[:, t, :].astype(jnp.float32)
+                                          for t in range(t_tiles)]
 
     if kind == "adam" and layout.r == 1 and d % 64 == 0:
         # fast path for the R=1 64-aligned layouts (e.g. the twotower d=64
@@ -725,8 +751,52 @@ def _line_math(x, gp, tl, corr, layout: LineLayout, *, lr, b1, b2, eps,
     return jnp.stack(new, axis=1)
 
 
+def _sr_writeback(new, seed_ref, block, dtype):
+    """Requantize a computed [rows, T, 128] f32 block to the line STORAGE
+    dtype at the scratch writeback.
+
+    f32 storage returns ``new`` untouched (the f32 kernel is bit-identical
+    to before the dtype layer existed).  Narrow storage without a seed is
+    round-to-nearest.  With a seed it applies the same unbiased
+    stochastic-rounding bit trick as ``ops/quant.py`` — add uniform low-16
+    bits to the f32 pattern, truncate — but the uniform bits come from a
+    counter-based murmur3-finalizer hash of (seed, element position, grid
+    block) in plain lax ops: ``pltpu.prng_seed`` has no interpret-mode
+    lowering in this jax, and a hash of static positions is deterministic
+    by construction (same inputs + seed -> same bits, kill/resume-exact).
+    Exactly-representable values round-trip bit-exactly (the low-16 add
+    cannot carry), so sentinel/untouched lines in the block are preserved
+    even before their write-skip.
+    """
+    if jnp.dtype(dtype) == jnp.float32:
+        return new
+    if seed_ref is None:
+        return new.astype(dtype)
+    seed = seed_ref[0].astype(jnp.uint32)
+    rows, t_tiles = new.shape[0], new.shape[1]
+    out = []
+    for t in range(t_tiles):
+        x = new[:, t, :]
+        # global element index within the block: row-major over [rows, T*128]
+        idx = (jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANE), 0)
+               * jnp.uint32(t_tiles * _LANE)
+               + jnp.uint32(t * _LANE)
+               + jax.lax.broadcasted_iota(jnp.uint32, (rows, _LANE), 1))
+        h = (idx * jnp.uint32(0x9E3779B1) + seed
+             + block.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B))
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+        u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+        v = (u + (h & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000)
+        out.append(jax.lax.bitcast_convert_type(v, jnp.float32))
+    return jnp.stack(out, axis=1).astype(dtype)
+
+
 def fat_line_update(
-    fat: jax.Array,      # [L, T, 128] f32 fat lines (line_layout)
+    fat: jax.Array,      # [L, T, 128] fat lines (line_layout), f32 or bf16
     ulines: jax.Array,   # [U] unique LINE ids; sentinel = int32 max
     gp: jax.Array,       # [U, T, 128] packed summed grads (table lanes) —
     #                      or, with R == 1, ROW-form [U, d] (streams d lanes
@@ -743,6 +813,7 @@ def fat_line_update(
     eps: float = 1e-8,
     weight_decay: float = 0.0,
     lines_per_step: int = 128,
+    sr_seed: jax.Array | None = None,
     interpret: bool = False,
 ):
     """In-place fused optimizer step on the touched lines of a fat table.
@@ -762,7 +833,16 @@ def fat_line_update(
     Requires ``ulines`` duplicate-free: duplicate line ids would race on the
     same fat line across grid steps.  (fbgemm fused TBE contract,
     ``torchrec/train.py:191-195``.)
+
+    bf16 fat lines compute in f32 and requantize at the scratch writeback
+    (:func:`_sr_writeback`; ``sr_seed`` — a scalar int32 — enables
+    stochastic rounding, fbgemm quantized-TBE parity).  The seed rides a
+    conditional SMEM operand: the f32 call graph — operand list, alias
+    indices, kernel signature — is byte-identical to the pre-dtype-layer
+    kernel, so default configs cannot regress.
     """
+    quant = jnp.dtype(fat.dtype) != jnp.float32
+    use_sr = bool(quant) and sr_seed is not None
     n_lines, t_tiles, lane = fat.shape
     assert lane == _LANE and t_tiles == layout.tiles, (fat.shape, layout)
     row_form = gp.ndim == 2
@@ -788,11 +868,18 @@ def fat_line_update(
         tl_specs = (pl.BlockSpec((lines_per_step, t_tiles, _LANE),
                                  lambda i, ids: (i, 0, 0)),)
 
+    # SR seed as a conditional SMEM scalar: present ONLY for narrow storage
+    # with a seed, so the f32 operand layout (and alias index) is unchanged
+    seed_ops = ((jnp.asarray(sr_seed, jnp.int32).reshape(1),)
+                if use_sr else ())
+    seed_specs = ((pl.BlockSpec(memory_space=pltpu.SMEM),) if use_sr else ())
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(u_pad // lines_per_step,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # [c1, c2] bias corrections
+            *seed_specs,
             gp_spec,
             *tl_specs,
             pl.BlockSpec(memory_space=pl.ANY),  # fat (HBM, manual DMA)
@@ -800,8 +887,10 @@ def fat_line_update(
         out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased with fat
         scratch_shapes=[
             # DOUBLE-buffered line scratch: block i+1's reads overlap block
-            # i's compute, block i-1's writes drain one step behind
-            pltpu.VMEM((2, lines_per_step, t_tiles, _LANE), jnp.float32),
+            # i's compute, block i-1's writes drain one step behind.
+            # STORAGE dtype: bf16 lines halve both the scratch footprint and
+            # the per-line DMA bytes (compute widens to f32 in _line_math)
+            pltpu.VMEM((2, lines_per_step, t_tiles, _LANE), fat.dtype),
             # ONE semaphore per (buffer, line) serves reads AND writes: on a
             # given slot they strictly alternate (read.start/wait -> compute
             # -> write.start, drained before the slot's next read), and two
@@ -810,7 +899,9 @@ def fat_line_update(
         ],
     )
 
-    def kernel(ids_ref, corr_ref, g_ref, *rest):
+    def kernel(ids_ref, corr_ref, *args):
+        seed_ref = args[0] if use_sr else None
+        g_ref, *rest = args[1:] if use_sr else args
         t_ref = None if row_form else rest[0]
         fat_hbm, out_hbm, scratch, sems = rest[-4:]
         i = pl.program_id(0)
@@ -892,14 +983,15 @@ def fat_line_update(
                     tl_in = [jnp.ones((lines_per_step, _LANE), jnp.float32)
                              for _ in range(t_tiles)]
                 else:
-                    gg = g_ref[...]
-                    tt = t_ref[...]
+                    gg = g_ref[...].astype(jnp.float32)
+                    tt = t_ref[...].astype(jnp.float32)
                     gs = [gg[:, t, :] for t in range(t_tiles)]
                     tl_in = [tt[:, t, :] for t in range(t_tiles)]
-                scratch[p] = _line_math(
+                new = _line_math(
                     x, gs, tl_in, corr_ref, layout, lr=lr,
                     b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
                 )
+                scratch[p] = _sr_writeback(new, seed_ref, i, fat.dtype)
                 for r in range(lines_per_step):
                     ok, cp = write_copy(i, p, r)
 
@@ -921,13 +1013,13 @@ def fat_line_update(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(fat.shape, fat.dtype),
-        # fat (operands: ids, corr, gp, [tl,] fat)
-        input_output_aliases={3 if row_form else 4: 0},
+        # fat (operands: ids, corr, [seed,] gp, [tl,] fat)
+        input_output_aliases={(3 if row_form else 4) + len(seed_ops): 0},
         compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(ulines_p, corr, gp_p, *tl_ops, fat)
+    )(ulines_p, corr, *seed_ops, gp_p, *tl_ops, fat)
 
 
 def routed_lines_per_step(layout: LineLayout) -> int:
@@ -967,6 +1059,7 @@ def fat_line_update_routed(
     b2: float = 0.999,
     eps: float = 1e-8,
     weight_decay: float = 0.0,
+    sr_seed: jax.Array | None = None,
     interpret: bool = False,
 ):
     """:func:`fat_line_update` with IN-KERNEL operand routing.
@@ -984,7 +1077,14 @@ def fat_line_update_routed(
     from the same matrices for free.  The current line contents arrive as
     the regular blocked ``lines`` input (reusing the forward's gather), so
     the only scattered DMAs are the write-backs.
+
+    bf16 storage: same contract as :func:`fat_line_update` — f32 compute,
+    :func:`_sr_writeback` requantize, conditional SMEM ``sr_seed`` operand
+    keeping the f32 call graph byte-identical.  ``lines`` arrives at the
+    table's storage dtype (it is the forward's gather of ``fat``).
     """
+    quant = jnp.dtype(fat.dtype) != jnp.float32
+    use_sr = bool(quant) and sr_seed is not None
     n_lines, t_tiles, lane = fat.shape
     d, r, w = layout.d, layout.r, layout.w
     assert lane == _LANE and t_tiles == layout.tiles, (fat.shape, layout)
@@ -995,11 +1095,16 @@ def fat_line_update_routed(
     rpb = lines_per_step * r
     assert lines.shape == (c, t_tiles, _LANE), lines.shape
 
+    seed_ops = ((jnp.asarray(sr_seed, jnp.int32).reshape(1),)
+                if use_sr else ())
+    seed_specs = ((pl.BlockSpec(memory_space=pltpu.SMEM),) if use_sr else ())
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # ulines, sdiv
         grid=(nblocks,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # corr
+            *seed_specs,
             pl.BlockSpec((None, 8, 2 * rpb), lambda i, ids, sd: (i, 0, 0)),
             pl.BlockSpec((lines_per_step, t_tiles, _LANE),
                          lambda i, ids, sd: (i, 0, 0)),  # current lines
@@ -1012,7 +1117,8 @@ def fat_line_update_routed(
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),  # aliased with fat
         scratch_shapes=[
-            pltpu.VMEM((2, lines_per_step, t_tiles, _LANE), jnp.float32),
+            # storage dtype (halved write-back DMA bytes for bf16 lines)
+            pltpu.VMEM((2, lines_per_step, t_tiles, _LANE), fat.dtype),
             pltpu.VMEM((2, 2 * rpb, _LANE), jnp.float32),  # g windows
             pltpu.SemaphoreType.DMA((2, lines_per_step)),
             pltpu.SemaphoreType.DMA((2,)),  # one bulk window copy per block
@@ -1020,8 +1126,10 @@ def fat_line_update_routed(
     )
     assert g_u.shape[1] == _LANE, g_u.shape
 
-    def kernel(ids_ref, sdiv_ref, corr_ref, tsi_ref, lines_ref, g_hbm,
-               fat_hbm, out_hbm, scratch, gwin, sems, gsems):
+    def kernel(ids_ref, sdiv_ref, corr_ref, *args):
+        seed_ref = args[0] if use_sr else None
+        (tsi_ref, lines_ref, g_hbm, fat_hbm, out_hbm,
+         scratch, gwin, sems, gsems) = args[1:] if use_sr else args
         i = pl.program_id(0)
         nsteps = pl.num_programs(0)
 
@@ -1113,10 +1221,11 @@ def fat_line_update_routed(
                          for t in range(t_tiles)], axis=1)
                     tlw = occ[0] * jnp.ones((1, _LANE), jnp.float32)
                     tl = jnp.stack([tlw] * t_tiles, axis=1)
-                scratch[p] = _line_math(
+                new = _line_math(
                     x, gp, tl, corr_ref, layout, lr=lr, b1=b1, b2=b2,
                     eps=eps, weight_decay=weight_decay,
                 )
+                scratch[p] = _sr_writeback(new, seed_ref, i, fat.dtype)
                 for q in range(lines_per_step):
                     ok, cp = write_copy(i, p, q)
 
@@ -1145,10 +1254,11 @@ def fat_line_update_routed(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(fat.shape, fat.dtype),
-        # operands: ulines, sdiv, corr, tsi, lines, g_u, fat
-        input_output_aliases={6: 0},
+        # operands: ulines, sdiv, corr, [seed,] tsi, lines, g_u, fat
+        input_output_aliases={6 + len(seed_ops): 0},
         compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
         interpret=interpret,
-    )(ulines, sdiv, corr, tsi, lines, g_u.astype(jnp.float32), fat)
+    )(ulines, sdiv, corr, *seed_ops, tsi, lines,
+      g_u.astype(jnp.float32), fat)

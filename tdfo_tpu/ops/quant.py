@@ -1,0 +1,88 @@
+"""Quantized embedding storage: narrow storage dtypes + stochastic rounding.
+
+fbgemm_gpu's ``split_table_batched_embeddings`` (the TBE stack under
+``torchrec/train.py``) stores tables and optimizer slots in reduced
+precision and requantizes writes with stochastic rounding; this module is
+the same contract for the GSPMD/Pallas tables.  Storage is narrow
+(``bfloat16``), compute stays f32: reads widen the small gathered block
+AFTER the row gather (never the table), writes requantize here.
+
+Stochastic rounding uses the classic bit trick: add uniform random low-16
+bits to the f32 bit pattern, truncate the mantissa.  Two properties the
+rest of the PR leans on:
+
+  * unbiased: E[round(x)] == x for any f32 input;
+  * identity on exactly-representable values: a bf16-representable f32 has
+    zero low-16 mantissa bits, so adding rand <= 0xFFFF can never carry
+    into the kept bits.  Untouched rows that ride through a full-block
+    requantize (``jnp.where(touched, new, old)`` sweeps, fat-line blocks)
+    therefore round-trip bit-exactly.
+
+Determinism: keys derive from ``(step, table_id)`` via counter-style
+``fold_in`` chains (no stateful RNG), so a training run is bit-reproducible
+and kill/restart-identical under the PR-1 resume machinery — the restored
+``state.step`` regenerates the exact key stream.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "STORAGE_DTYPES",
+    "component_key",
+    "quantize",
+    "sr_key",
+    "stochastic_round",
+    "table_id",
+]
+
+# the storage dtypes the [embeddings] table_dtype/slot_dtype knobs accept
+STORAGE_DTYPES = ("float32", "bfloat16")
+
+# arbitrary fixed base; all variation comes from the (step, table) folds
+_SR_BASE = 0x5EED
+
+
+def table_id(name: str) -> int:
+    """Stable 31-bit id of a table/array name for key folding (names are
+    config-derived strings, so the id survives restarts and host count)."""
+    return zlib.crc32(name.encode()) & 0x7FFFFFFF
+
+
+def sr_key(step: jax.Array | int, name: str) -> jax.Array:
+    """Counter-derived threefry key for stochastic rounding at ``step`` on
+    table ``name``.  Pure function of (step, table_id): bit-deterministic
+    across runs and identical after a kill/resume at the same step."""
+    k = jax.random.PRNGKey(_SR_BASE)
+    k = jax.random.fold_in(k, step)
+    return jax.random.fold_in(k, table_id(name))
+
+
+def component_key(key: jax.Array | None, index: int) -> jax.Array | None:
+    """Distinct subkey per written component (0=table, 1=mu/accum, 2=nu) so
+    no two buffers share rounding bits.  None passes through (f32 path)."""
+    return None if key is None else jax.random.fold_in(key, index)
+
+
+def stochastic_round(x: jax.Array, dtype, key: jax.Array) -> jax.Array:
+    """f32 -> ``dtype`` (bf16) with unbiased stochastic rounding."""
+    x = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    rand = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    out = (bits + rand) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(out, jnp.float32).astype(dtype)
+
+
+def quantize(x: jax.Array, dtype, key: jax.Array | None = None) -> jax.Array:
+    """Cast ``x`` to the storage ``dtype``: stochastic rounding when
+    narrowing with a key, round-to-nearest without one, and a PLAIN astype
+    for f32 targets — the default path stays byte-identical to unquantized
+    storage (the astype is an identity op XLA elides)."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.float32 or key is None:
+        return x.astype(dtype)
+    return stochastic_round(x, dtype, key)

@@ -28,6 +28,8 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tdfo_tpu.ops.quant import component_key, quantize
+
 __all__ = [
     "dedupe_grads",
     "dedupe_ids",
@@ -87,7 +89,11 @@ def dedupe_grads(
             "rejected at trace time."
         )
     uids, seg, valid = _dedupe_ids_impl(ids, capacity)
-    g = jax.ops.segment_sum(grads, seg, num_segments=capacity)
+    # widen BEFORE the segment-sum: bf16-stored tables hand back bf16
+    # embedding grads, and duplicate-id accumulation must happen in f32
+    # (identity for f32 inputs)
+    g = jax.ops.segment_sum(grads.astype(jnp.float32), seg,
+                            num_segments=capacity)
     g = jnp.where(valid[:, None], g, 0.0)
     return uids, g, valid
 
@@ -173,15 +179,23 @@ def _masked_scatter_rows(table: jax.Array, uids: jax.Array, new_rows: jax.Array,
     return table.at[uids].set(new_rows, mode="drop")
 
 
-def sparse_sgd(table, uids, g, valid, *, lr: float, weight_decay: float = 0.0):
-    """fbgemm EXACT_SGD parity: touched rows only, wd applied to touched rows."""
-    rows = table[uids]
-    g = g + weight_decay * rows
-    return _masked_scatter_rows(table, uids, rows - lr * g.astype(rows.dtype), valid)
+def sparse_sgd(table, uids, g, valid, *, lr: float, weight_decay: float = 0.0,
+               sr_key=None):
+    """fbgemm EXACT_SGD parity: touched rows only, wd applied to touched rows.
+
+    Storage dtype discipline (all ``sparse_*``/``dense_lazy_*`` functions):
+    gathered rows widen to f32, ALL math runs f32, and only the final write
+    requantizes (:func:`tdfo_tpu.ops.quant.quantize` — stochastic rounding
+    when ``sr_key`` is given and the table stores narrow; a plain identity
+    astype for f32 tables, keeping the default path byte-identical)."""
+    rows = table[uids].astype(jnp.float32)
+    g = g.astype(jnp.float32) + weight_decay * rows
+    new_rows = quantize(rows - lr * g, table.dtype, sr_key)
+    return _masked_scatter_rows(table, uids, new_rows, valid)
 
 
 def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
-                eps=1e-8, weight_decay=0.0):
+                eps=1e-8, weight_decay=0.0, sr_key=None):
     """Row-sparse AdamW: moments exist per-row; bias correction uses a global
     step count (matches fbgemm ADAM; per-row counts differ negligibly and a
     global count is what optax uses for the dense path).
@@ -190,9 +204,10 @@ def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
     fbgemm semantics, NOT optax's full-table decay.
     Returns (table, mu, nu, count).
     """
-    rows = table[uids]
-    mu_r, nu_r = mu[uids], nu[uids]
-    g = g.astype(mu_r.dtype)
+    rows = table[uids].astype(jnp.float32)
+    mu_r = mu[uids].astype(jnp.float32)
+    nu_r = nu[uids].astype(jnp.float32)
+    g = g.astype(jnp.float32)
     new_count = count + 1
     t = new_count.astype(jnp.float32)
     mu_n = b1 * mu_r + (1 - b1) * g
@@ -201,15 +216,22 @@ def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
     nu_hat = nu_n / (1 - b2**t)
     delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * rows)
     return (
-        _masked_scatter_rows(table, uids, rows - delta.astype(rows.dtype), valid),
-        _masked_scatter_rows(mu, uids, mu_n, valid),
-        _masked_scatter_rows(nu, uids, nu_n, valid),
+        _masked_scatter_rows(
+            table, uids,
+            quantize(rows - delta, table.dtype, component_key(sr_key, 0)),
+            valid),
+        _masked_scatter_rows(
+            mu, uids, quantize(mu_n, mu.dtype, component_key(sr_key, 1)),
+            valid),
+        _masked_scatter_rows(
+            nu, uids, quantize(nu_n, nu.dtype, component_key(sr_key, 2)),
+            valid),
         new_count,
     )
 
 
 def sparse_rowwise_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
-                           weight_decay=0.0):
+                           weight_decay=0.0, sr_key=None):
     """fbgemm EXACT_ROWWISE_ADAGRAD parity: ONE f32 accumulator PER ROW
     (mean of squared grads), not per element — optimizer state is V x 4
     bytes instead of V x D x 8, which is what lets a v5e hold a 4x10^8-row
@@ -217,32 +239,41 @@ def sparse_rowwise_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
     for huge tables; ``torchrec/train.py:191`` uses ADAM but fbgemm's TBE
     rowwise variant is the >=1B-row configuration).
     """
-    rows = table[uids]
-    acc_r = accum[uids]  # [U]
+    rows = table[uids].astype(jnp.float32)
+    acc_r = accum[uids]  # [U] — ALWAYS f32 (the fbgemm parity contract)
     g = g.astype(jnp.float32) + weight_decay * rows
     acc_n = acc_r + jnp.mean(g * g, axis=-1)
     delta = lr * g / (jnp.sqrt(acc_n)[:, None] + eps)
     return (
-        _masked_scatter_rows(table, uids, rows - delta.astype(rows.dtype), valid),
+        _masked_scatter_rows(
+            table, uids,
+            quantize(rows - delta, table.dtype, component_key(sr_key, 0)),
+            valid),
         _masked_scatter_rows(accum, uids, acc_n, valid),
     )
 
 
-def sparse_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10, weight_decay=0.0):
+def sparse_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
+                   weight_decay=0.0, sr_key=None):
     """fbgemm EXACT_ADAGRAD parity (row-wise accumulator of squared grads)."""
-    rows = table[uids]
-    acc_r = accum[uids]
-    g = g.astype(acc_r.dtype) + weight_decay * rows
+    rows = table[uids].astype(jnp.float32)
+    acc_r = accum[uids].astype(jnp.float32)
+    g = g.astype(jnp.float32) + weight_decay * rows
     acc_n = acc_r + g * g
     delta = lr * g / (jnp.sqrt(acc_n) + eps)
     return (
-        _masked_scatter_rows(table, uids, rows - delta.astype(rows.dtype), valid),
-        _masked_scatter_rows(accum, uids, acc_n, valid),
+        _masked_scatter_rows(
+            table, uids,
+            quantize(rows - delta, table.dtype, component_key(sr_key, 0)),
+            valid),
+        _masked_scatter_rows(
+            accum, uids,
+            quantize(acc_n, accum.dtype, component_key(sr_key, 1)), valid),
     )
 
 
 def dense_lazy_adam(table, mu, nu, count, ids, grads, *, lr, b1=0.9, b2=0.999,
-                    eps=1e-8, weight_decay=0.0):
+                    eps=1e-8, weight_decay=0.0, sr_key=None):
     """Small-vocab tier: lazy Adam via one-hot MXU matmuls + a dense masked
     sweep.  Per-row gradient sums and touched-row counts come from a single
     ``one_hot.T @ grads`` contraction (XLA fuses the one-hot generation into
@@ -260,16 +291,20 @@ def dense_lazy_adam(table, mu, nu, count, ids, grads, *, lr, b1=0.9, b2=0.999,
     gsum, touched = _one_hot_gsum(table, ids, grads)
     new_count = count + 1
     t = new_count.astype(jnp.float32)
-    mu_n = b1 * mu + (1 - b1) * gsum
-    nu_n = b2 * nu + (1 - b2) * gsum * gsum
+    tf = table.astype(jnp.float32)
+    mu_n = b1 * mu.astype(jnp.float32) + (1 - b1) * gsum
+    nu_n = b2 * nu.astype(jnp.float32) + (1 - b2) * gsum * gsum
     mu_hat = mu_n / (1 - b1**t)
     nu_hat = nu_n / (1 - b2**t)
-    delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps)
-                  + weight_decay * table.astype(jnp.float32))
+    delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * tf)
     return (
-        jnp.where(touched, table - delta.astype(table.dtype), table),
-        jnp.where(touched, mu_n, mu),
-        jnp.where(touched, nu_n, nu),
+        jnp.where(touched,
+                  quantize(tf - delta, table.dtype, component_key(sr_key, 0)),
+                  table),
+        jnp.where(touched,
+                  quantize(mu_n, mu.dtype, component_key(sr_key, 1)), mu),
+        jnp.where(touched,
+                  quantize(nu_n, nu.dtype, component_key(sr_key, 2)), nu),
         new_count,
     )
 
@@ -292,7 +327,7 @@ def _one_hot_gsum(table, ids, grads):
     return gsum, touched
 
 
-def dense_lazy_sgd(table, ids, grads, *, lr, weight_decay=0.0):
+def dense_lazy_sgd(table, ids, grads, *, lr, weight_decay=0.0, sr_key=None):
     """Scatter-free SGD for SMALL tables (hot-head arrays, vocab <= ~16k):
     duplicate ids merge in the one-hot contraction, then the whole [V, D]
     table takes one masked read-modify-write.  Row semantics are identical
@@ -301,35 +336,41 @@ def dense_lazy_sgd(table, ids, grads, *, lr, weight_decay=0.0):
     gsum, touched = _one_hot_gsum(table, ids, grads)
     g = gsum + weight_decay * table.astype(jnp.float32)
     new = table.astype(jnp.float32) - lr * g
-    return jnp.where(touched, new.astype(table.dtype), table)
+    return jnp.where(touched, quantize(new, table.dtype, sr_key), table)
 
 
 def dense_lazy_adagrad(table, accum, ids, grads, *, lr, eps=1e-10,
-                       weight_decay=0.0):
+                       weight_decay=0.0, sr_key=None):
     """Scatter-free EXACT_ADAGRAD (per-element accumulator) for small
     tables; row semantics identical to :func:`sparse_adagrad`.  Returns
     ``(table, accum)``."""
     gsum, touched = _one_hot_gsum(table, ids, grads)
     g = gsum + weight_decay * table.astype(jnp.float32)
-    acc_n = accum + g * g
+    acc_n = accum.astype(jnp.float32) + g * g
     delta = lr * g / (jnp.sqrt(acc_n) + eps)
     return (
-        jnp.where(touched, (table.astype(jnp.float32) - delta).astype(table.dtype), table),
-        jnp.where(touched, acc_n, accum),
+        jnp.where(touched,
+                  quantize(table.astype(jnp.float32) - delta, table.dtype,
+                           component_key(sr_key, 0)), table),
+        jnp.where(touched,
+                  quantize(acc_n, accum.dtype, component_key(sr_key, 1)),
+                  accum),
     )
 
 
 def dense_lazy_rowwise_adagrad(table, accum, ids, grads, *, lr, eps=1e-10,
-                               weight_decay=0.0):
+                               weight_decay=0.0, sr_key=None):
     """Scatter-free EXACT_ROWWISE_ADAGRAD (ONE f32 accumulator per row) for
     small tables; row semantics identical to
     :func:`sparse_rowwise_adagrad`.  Returns ``(table, accum)``."""
     gsum, touched = _one_hot_gsum(table, ids, grads)
     g = gsum + weight_decay * table.astype(jnp.float32)
-    acc_n = accum + jnp.mean(g * g, axis=-1)  # [V]
+    acc_n = accum + jnp.mean(g * g, axis=-1)  # [V] — accum is always f32
     delta = lr * g / (jnp.sqrt(acc_n)[:, None] + eps)
     return (
-        jnp.where(touched, (table.astype(jnp.float32) - delta).astype(table.dtype), table),
+        jnp.where(touched,
+                  quantize(table.astype(jnp.float32) - delta, table.dtype,
+                           component_key(sr_key, 0)), table),
         jnp.where(touched[:, 0], acc_n, accum),
     )
 
@@ -381,7 +422,7 @@ def _pack_lanes(g_slots, touched, layout):
 
 
 def _fat_apply_lines_xla(fat, ulines, g_slots, touched, *, layout, lr, b1,
-                         b2, eps, weight_decay, new_count=None):
+                         b2, eps, weight_decay, new_count=None, sr_key=None):
     """Portable line-level formulation: gather every slot row of the
     touched lines through the [L*R, W] view, apply the per-row optimizer
     math (bit-identical to the plain-table ``sparse_*`` functions) gated by
@@ -397,6 +438,7 @@ def _fat_apply_lines_xla(fat, ulines, g_slots, touched, *, layout, lr, b1,
     base = jnp.where(ulines < n_lines, ulines, n_lines).astype(jnp.int32)
     idx = (base[:, None] * r + jnp.arange(r, dtype=jnp.int32)[None, :]).reshape(-1)
     rows_full = jnp.take(view, jnp.minimum(idx, view.shape[0] - 1), axis=0)
+    rows_full = rows_full.astype(jnp.float32)  # widen AFTER the gather
     table = rows_full[:, :d]
     g = g_slots.astype(jnp.float32)
     kind = layout.kind
@@ -428,6 +470,9 @@ def _fat_apply_lines_xla(fat, ulines, g_slots, touched, *, layout, lr, b1,
     for off, comp in parts.items():
         new_rows = jax.lax.dynamic_update_slice_in_dim(new_rows, comp, off, axis=1)
     new_rows = jnp.where(touched.reshape(-1)[:, None] > 0, new_rows, rows_full)
+    # whole-block requantize: untouched rows are exactly representable, so
+    # stochastic rounding is an identity on them (ops/quant.py bit trick)
+    new_rows = quantize(new_rows, fat.dtype, sr_key)
     return view.at[idx].set(new_rows, mode="drop").reshape(fat.shape)
 
 
@@ -470,9 +515,18 @@ def dedupe_rows_and_lines(ids, *, capacity_rows: int, capacity_lines: int,
     return seg_row, ulines, row_lidx, row_slot
 
 
+def _kernel_seed(sr_key, dtype):
+    """Scalar int32 stochastic-rounding seed for the fat-line kernels
+    (None = no SR: f32 storage, or no key -> round-to-nearest)."""
+    if sr_key is None or jnp.dtype(dtype) == jnp.float32:
+        return None
+    return jax.random.randint(sr_key, (), 0, jnp.iinfo(jnp.int32).max,
+                              dtype=jnp.int32)
+
+
 def fat_apply_routed(fat, slots, ulines, g_u, row_lidx, row_slot, lines, *,
                      embedding_dim, kind, lr, b1=0.9, b2=0.999, eps=1e-8,
-                     weight_decay=0.0, interpret: bool = False):
+                     weight_decay=0.0, interpret: bool = False, sr_key=None):
     """Fused fat-line step on ROW-level summed grads + routing info from
     :func:`dedupe_rows_and_lines` — the fastest update path: the expensive
     C x R slot-space segment-sum never exists; the kernel routes window
@@ -531,7 +585,7 @@ def fat_apply_routed(fat, slots, ulines, g_u, row_lidx, row_slot, lines, *,
         fat = fat_line_update_routed(
             fat, lines_p, ulines_p, sdiv, tsi, g_pad, corr, layout=layout,
             lr=lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay,
-            interpret=interpret,
+            interpret=interpret, sr_seed=_kernel_seed(sr_key, fat.dtype),
         )
         return fat, new_slots
     # XLA fallback: construct the line-slot operands by (cheap on CPU)
@@ -545,13 +599,14 @@ def fat_apply_routed(fat, slots, ulines, g_u, row_lidx, row_slot, lines, *,
     fat = _fat_apply_lines_xla(
         fat, ulines, g_slots, touched, layout=layout, lr=lr, b1=b1, b2=b2,
         eps=eps, weight_decay=weight_decay, new_count=new_count,
+        sr_key=sr_key,
     )
     return fat, new_slots
 
 
 def _fat_apply_lines(fat, slots, ulines, g_slots, touched, *, layout, lr,
                      b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
-                     interpret: bool = False):
+                     interpret: bool = False, sr_key=None):
     """Shared line-level dispatch: kernel on TPU (or interpret), XLA
     formulation elsewhere.  ``g_slots``: [C*R, d] summed grads in line-slot
     order; ``touched``: [C*R] occupancy (any dtype, > 0 = touched).
@@ -581,12 +636,14 @@ def _fat_apply_lines(fat, slots, ulines, g_slots, touched, *, layout, lr,
     # d > 128 lines span 4+ tiles — rare configs with no on-chip coverage;
     # keep them on the proven XLA formulation (the pre-existing guard)
     if layout.d <= 128 and (jax.default_backend() == "tpu" or interpret):
+        sr_seed = _kernel_seed(sr_key, fat.dtype)
         if layout.r == 1:
             # row-form operands: stream d lanes per line, no touched mask
             fat = fat_line_update(
                 fat, ulines, g_slots.reshape(c, -1).astype(jnp.float32),
                 None, corr, layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
                 weight_decay=weight_decay, interpret=interpret,
+                sr_seed=sr_seed,
             )
         else:
             gp, tl = _pack_lanes(g_slots.astype(jnp.float32), touched_f,
@@ -594,20 +651,20 @@ def _fat_apply_lines(fat, slots, ulines, g_slots, touched, *, layout, lr,
             fat = fat_line_update(
                 fat, ulines, gp, tl, corr, layout=layout, lr=lr, b1=b1,
                 b2=b2, eps=eps, weight_decay=weight_decay,
-                interpret=interpret,
+                interpret=interpret, sr_seed=sr_seed,
             )
     else:
         fat = _fat_apply_lines_xla(
             fat, ulines, g_slots.reshape(c * layout.r, -1), touched_f,
             layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
-            weight_decay=weight_decay, new_count=new_count,
+            weight_decay=weight_decay, new_count=new_count, sr_key=sr_key,
         )
     return fat, new_slots
 
 
 def fat_apply_unique(fat, slots, uids, g, valid=None, *, embedding_dim, kind,
                      lr, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
-                     interpret: bool = False):
+                     interpret: bool = False, sr_key=None):
     """Fused fat-line optimizer step on PRE-deduplicated row-level
     ``(uids, g)``.  ``uids`` must be sorted ascending with int32-max
     sentinels at the top (the :func:`dedupe_grads` layout) — the line
@@ -626,14 +683,14 @@ def fat_apply_unique(fat, slots, uids, g, valid=None, *, embedding_dim, kind,
     return _fat_apply_lines(
         fat, slots, ulines, g_slots.reshape(-1, g_slots.shape[-1]),
         touched.reshape(-1), layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
-        weight_decay=weight_decay, interpret=interpret,
+        weight_decay=weight_decay, interpret=interpret, sr_key=sr_key,
     )
 
 
 def fat_update(fat, slots, ids, grads, *, embedding_dim, kind, lr, b1=0.9,
                b2=0.999, eps=1e-8, weight_decay=0.0,
                capacity: int | None = None, max_distinct: int | None = None,
-               interpret: bool = False):
+               interpret: bool = False, sr_key=None):
     """Big-table tier: fused in-backward optimizer over packed fat lines
     (``pallas_kernels.line_layout``) — fbgemm TBE parity for every
     ``EmbOptimType`` kind the framework exposes (adam / sgd / adagrad /
@@ -663,6 +720,7 @@ def fat_update(fat, slots, ids, grads, *, embedding_dim, kind, lr, b1=0.9,
     return _fat_apply_lines(
         fat, slots, ulines, g_slots, touched, layout=layout, lr=lr, b1=b1,
         b2=b2, eps=eps, weight_decay=weight_decay, interpret=interpret,
+        sr_key=sr_key,
     )
 
 
@@ -692,29 +750,38 @@ class SparseOptimizer:
     b2: float = 0.999
     eps: float = 1e-8
     small_vocab_threshold: int = 16384
+    # STORAGE dtype of the adam/adagrad slot buffers of plain tables
+    # ("float32" | "bfloat16"; fbgemm mixed-precision TBE parity).  Fat-line
+    # tables pack state at the TABLE dtype; rowwise_adagrad's per-row
+    # accumulator stays f32 regardless (the parity contract — config
+    # rejects the bf16 combination).  Writes requantize via the same
+    # ``sr_key`` stream as the tables.
+    slot_dtype: str = "float32"
 
     def init(self, table: jax.Array) -> Any:
         if table.ndim == 3:  # fat lines carry their own optimizer state
             # adam keeps the global step count for bias correction; the
             # other kinds are fully self-contained in the packed rows
             return (jnp.zeros((), jnp.int32),) if self.kind == "adam" else ()
+        sd = jnp.dtype(self.slot_dtype)
         if self.kind == "sgd":
             return ()
         if self.kind == "adagrad":
-            return (jnp.zeros_like(table, dtype=jnp.float32),)
+            return (jnp.zeros_like(table, dtype=sd),)
         if self.kind == "rowwise_adagrad":
             # ONE f32 cell per row: the state layout that scales to 1e9 rows
+            # (always f32 — slot_dtype does not apply to this kind)
             return (jnp.zeros((table.shape[0],), jnp.float32),)
         if self.kind == "adam":
             return (
-                jnp.zeros_like(table, dtype=jnp.float32),
-                jnp.zeros_like(table, dtype=jnp.float32),
+                jnp.zeros_like(table, dtype=sd),
+                jnp.zeros_like(table, dtype=sd),
                 jnp.zeros((), jnp.int32),
             )
         raise ValueError(f"unknown sparse optimizer kind: {self.kind!r}")
 
     def update_routed(self, table, slots, ulines, g_u, row_lidx, row_slot,
-                      lines, *, embedding_dim: int):
+                      lines, *, embedding_dim: int, sr_key=None):
         """Fat-line fastest path: row-level summed grads + routing arrays
         from :func:`dedupe_rows_and_lines` (the dedup-lookup step shares
         ONE sort between the forward's line gather — whose result ``lines``
@@ -726,11 +793,11 @@ class SparseOptimizer:
             table, slots, ulines, g_u, row_lidx, row_slot, lines,
             embedding_dim=embedding_dim, kind=self.kind, lr=self.lr,
             b1=self.b1, b2=self.b2, eps=self.eps,
-            weight_decay=self.weight_decay,
+            weight_decay=self.weight_decay, sr_key=sr_key,
         )
 
     def update_unique(self, table, slots, uids, g, valid, *,
-                      embedding_dim: int | None = None):
+                      embedding_dim: int | None = None, sr_key=None):
         """Tier dispatch on PRE-deduplicated ``(uids, g, valid)`` — the
         dedup-lookup step path (one shared sort per array per step).  The
         small-vocab one-hot tier needs raw ids and is bypassed here;
@@ -741,33 +808,35 @@ class SparseOptimizer:
             return fat_apply_unique(
                 table, slots, uids, g, valid, embedding_dim=embedding_dim,
                 kind=self.kind, lr=self.lr, b1=self.b1, b2=self.b2,
-                eps=self.eps, weight_decay=self.weight_decay,
+                eps=self.eps, weight_decay=self.weight_decay, sr_key=sr_key,
             )
         if self.kind == "sgd":
             return sparse_sgd(table, uids, g, valid, lr=self.lr,
-                              weight_decay=self.weight_decay), slots
+                              weight_decay=self.weight_decay,
+                              sr_key=sr_key), slots
         if self.kind == "adagrad":
             (accum,) = slots
             table, accum = sparse_adagrad(
                 table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay)
+                weight_decay=self.weight_decay, sr_key=sr_key)
             return table, (accum,)
         if self.kind == "rowwise_adagrad":
             (accum,) = slots
             table, accum = sparse_rowwise_adagrad(
                 table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay)
+                weight_decay=self.weight_decay, sr_key=sr_key)
             return table, (accum,)
         if self.kind == "adam":
             mu, nu, count = slots
             table, mu, nu, count = sparse_adam(
                 table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+                sr_key=sr_key,
             )
             return table, (mu, nu, count)
         raise ValueError(self.kind)
 
-    def dense_update(self, table, slots, ids, grads):
+    def dense_update(self, table, slots, ids, grads, *, sr_key=None):
         """Scatter-free tier for SMALL plain tables regardless of kind — the
         hot-head arrays of the frequency-partitioned embedding mode
         (``parallel/embedding.py`` hot/cold): duplicate ids merge inside a
@@ -779,24 +848,25 @@ class SparseOptimizer:
         if table.ndim != 3 and self.kind == "sgd":
             return dense_lazy_sgd(
                 table, ids, grads, lr=self.lr,
-                weight_decay=self.weight_decay), ()
+                weight_decay=self.weight_decay, sr_key=sr_key), ()
         if table.ndim != 3 and self.kind == "adagrad":
             (accum,) = slots
             table, accum = dense_lazy_adagrad(
                 table, accum, ids, grads, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay)
+                weight_decay=self.weight_decay, sr_key=sr_key)
             return table, (accum,)
         if table.ndim != 3 and self.kind == "rowwise_adagrad":
             (accum,) = slots
             table, accum = dense_lazy_rowwise_adagrad(
                 table, accum, ids, grads, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay)
+                weight_decay=self.weight_decay, sr_key=sr_key)
             return table, (accum,)
         if table.ndim != 3 and self.kind == "adam":
             mu, nu, count = slots
             table, mu, nu, count = dense_lazy_adam(
                 table, mu, nu, count, ids, grads, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+                sr_key=sr_key,
             )
             return table, (mu, nu, count)
         raise ValueError(
@@ -804,7 +874,8 @@ class SparseOptimizer:
             f"ndim {table.ndim})")
 
     def update(self, table, slots, ids, grads, *, embedding_dim: int | None = None,
-               capacity: int | None = None, max_distinct: int | None = None):
+               capacity: int | None = None, max_distinct: int | None = None,
+               sr_key=None):
         if table.ndim == 3:
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
@@ -812,13 +883,14 @@ class SparseOptimizer:
                 table, slots, ids, grads, embedding_dim=embedding_dim,
                 kind=self.kind, lr=self.lr, b1=self.b1, b2=self.b2,
                 eps=self.eps, weight_decay=self.weight_decay,
-                capacity=capacity, max_distinct=max_distinct,
+                capacity=capacity, max_distinct=max_distinct, sr_key=sr_key,
             )
         if self.kind == "adam" and table.shape[0] <= self.small_vocab_threshold:
             mu, nu, count = slots
             table, mu, nu, count = dense_lazy_adam(
                 table, mu, nu, count, ids, grads, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+                sr_key=sr_key,
             )
             return table, (mu, nu, count)
         uids, g, valid = dedupe_grads(ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
@@ -826,23 +898,27 @@ class SparseOptimizer:
                                       max_distinct=max_distinct)
         if self.kind == "sgd":
             return sparse_sgd(table, uids, g, valid, lr=self.lr,
-                              weight_decay=self.weight_decay), slots
+                              weight_decay=self.weight_decay,
+                              sr_key=sr_key), slots
         if self.kind == "adagrad":
             (accum,) = slots
             table, accum = sparse_adagrad(table, accum, uids, g, valid, lr=self.lr,
-                                          eps=self.eps, weight_decay=self.weight_decay)
+                                          eps=self.eps,
+                                          weight_decay=self.weight_decay,
+                                          sr_key=sr_key)
             return table, (accum,)
         if self.kind == "rowwise_adagrad":
             (accum,) = slots
             table, accum = sparse_rowwise_adagrad(
                 table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay)
+                weight_decay=self.weight_decay, sr_key=sr_key)
             return table, (accum,)
         if self.kind == "adam":
             mu, nu, count = slots
             table, mu, nu, count = sparse_adam(
                 table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
+                sr_key=sr_key,
             )
             return table, (mu, nu, count)
         raise ValueError(self.kind)

@@ -112,7 +112,10 @@ class EmbeddingSpec:
     # fused_kind) so the optimizer read-modify-writes one aligned DMA
     # descriptor per touched line — the fbgemm-TBE-equivalent layout that
     # makes O(batch) updates fast on TPU for every EmbOptimType kind
-    # (adam / sgd / adagrad / rowwise_adagrad).  f32 only.
+    # (adam / sgd / adagrad / rowwise_adagrad).  Storage dtype follows
+    # ``dtype`` (f32 or bf16; bf16 lines pack the optimizer state narrow
+    # too, so fused rowwise_adagrad — whose accumulator is contractually
+    # f32 — rejects bf16 at collection construction).
     fused: bool = False
 
     def feature_names(self) -> tuple[str, ...]:
@@ -256,8 +259,20 @@ class ShardedEmbeddingCollection:
                     f"table {s.name!r}: fused storage supports row/replicated "
                     f"sharding, not {s.sharding!r}"
                 )
-            if s.fused and s.dtype != jnp.float32:
-                raise ValueError(f"table {s.name!r}: fused storage is f32 only")
+            if s.fused and jnp.dtype(s.dtype) not in (
+                    jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+                raise ValueError(
+                    f"table {s.name!r}: fused storage supports float32/"
+                    f"bfloat16, not {jnp.dtype(s.dtype).name}")
+            if (s.fused and jnp.dtype(s.dtype) == jnp.bfloat16
+                    and fused_kind == "rowwise_adagrad"):
+                # fat lines pack table AND state at one dtype; the rowwise
+                # accumulator is contractually f32 per row (fbgemm
+                # EXACT_ROWWISE_ADAGRAD), so it cannot ride a bf16 line
+                raise ValueError(
+                    f"table {s.name!r}: fused rowwise_adagrad storage "
+                    "cannot be bfloat16 (the per-row accumulator is f32 by "
+                    "the fbgemm parity contract)")
             for f in s.feature_names():
                 if f in self._feature_to_table:
                     raise ValueError(f"feature {f!r} served by two tables")
@@ -280,10 +295,13 @@ class ShardedEmbeddingCollection:
             by_key: dict[tuple, list[EmbeddingSpec]] = {}
             for s in members:
                 # canonical dtype NAME ("float32"), never str(class): two
-                # spellings of one dtype must land in one group, and the
-                # name becomes a checkpoint key (fused storage is f32-only,
-                # so fused groups need no dtype discriminator at all)
-                dt = "" if fused else jnp.dtype(s.dtype).name
+                # spellings of one dtype must land in one group — mixed
+                # f32/bf16 tables must NOT concatenate into one stream —
+                # and the name becomes a checkpoint key.  f32 fused groups
+                # keep the historical un-suffixed name (byte-stable
+                # checkpoints); bf16 fused stacks carry the dtype suffix.
+                dt = ("" if fused and jnp.dtype(s.dtype) == jnp.float32
+                      else jnp.dtype(s.dtype).name)
                 by_key.setdefault(
                     (s.embedding_dim, s.sharding, dt), []).append(s)
             prefix = "__fatstack_" if fused else "__tablestack_"
@@ -291,7 +309,7 @@ class ShardedEmbeddingCollection:
                     by_key.items(), key=lambda kv: str(kv[0])):
                 if len(group) < 2:
                     continue  # single tables keep their own array (and name)
-                gname = (f"{prefix}{dim}_{shard_kind}" if fused
+                gname = (f"{prefix}{dim}_{shard_kind}" if fused and not dt
                          else f"{prefix}{dim}_{shard_kind}_{dt}")
                 total = sum(s.num_embeddings for s in group)
                 # fused stacks additionally round to whole LINES so shard
@@ -529,7 +547,7 @@ class ShardedEmbeddingCollection:
             if gname.startswith("__fatstack_"):
                 from tdfo_tpu.ops.pallas_kernels import fat_pack
 
-                t = assemble_stack(group, next(key_iter), jnp.float32)
+                t = assemble_stack(group, next(key_iter), group[0].dtype)
                 arr = fat_pack(t, kind=self.fused_kind)  # [lines, T, 128]
             else:  # plain 2D table stack (stack_tables=True)
                 arr = assemble_stack(group, next(key_iter), group[0].dtype)
@@ -616,7 +634,7 @@ class ShardedEmbeddingCollection:
                 and self.mesh is not None and self.n_shards > 1)
 
     def sparse_update(self, opt, array_name: str, table, slots, ids, grads,
-                      max_distinct: int | None = None):
+                      max_distinct: int | None = None, sr_key=None):
         """Apply the row-sparse optimizer to one table, sharding-aware.
 
         For fused (fat-row) tables ROW-SHARDED over a real model axis the
@@ -628,11 +646,19 @@ class ShardedEmbeddingCollection:
         the local shard.  Every data-axis replica computes its model shard's
         update identically, so the result stays consistent and sharded.
         Everything else routes straight to ``opt.update``.
+
+        ``sr_key``: stochastic-rounding key for narrow-storage tables
+        (``ops/quant.sr_key``); ``None`` leaves the f32 call graph
+        untouched.  Inside the shard_map the key is folded with the MODEL
+        axis index so shards draw independent rounding bits, while data-
+        axis replicas (which recompute the same shard update) stay
+        identical.
         """
         d = self.array_embedding_dim(array_name)
         if not self.needs_shard_map_update(array_name):
             return opt.update(table, slots, ids, grads, embedding_dim=d,
-                              capacity=max_distinct, max_distinct=max_distinct)
+                              capacity=max_distinct, max_distinct=max_distinct,
+                              sr_key=sr_key)
 
         from tdfo_tpu.core.mesh import DATA_AXIS
         from tdfo_tpu.ops.sparse import fat_update
@@ -645,7 +671,7 @@ class ShardedEmbeddingCollection:
         ids_flat = ids.reshape(-1)
         grads_flat = grads.reshape(-1, grads.shape[-1])
 
-        def local(fat_shard, slots_in, ids_local, grads_local):
+        def local(fat_shard, slots_in, ids_local, grads_local, *key_in):
             ids_all = jax.lax.all_gather(ids_local, DATA_AXIS, tiled=True)
             g_all = jax.lax.all_gather(grads_local, DATA_AXIS, tiled=True)
             k = jax.lax.axis_index(axis)
@@ -655,23 +681,27 @@ class ShardedEmbeddingCollection:
             # dropped sentinel; their (zeroed) grads contribute nothing
             masked = jnp.where(mine, local_ids, -1)
             g_masked = jnp.where(mine[:, None], g_all, 0.0)
+            sk = (jax.random.fold_in(key_in[0], k) if key_in else None)
             return fat_update(
                 fat_shard, slots_in, masked, g_masked, embedding_dim=d,
                 kind=kind, lr=opt.lr, b1=opt.b1, b2=opt.b2, eps=opt.eps,
                 weight_decay=opt.weight_decay,
                 capacity=max_distinct, max_distinct=max_distinct,
+                sr_key=sk,
             )
 
         mesh = self.mesh
         fat_spec = P(axis, None, None)
         slots_spec = tuple(P() for _ in slots)
+        key_ops = () if sr_key is None else (sr_key,)
         new_table, new_slots = shard_map(
             local,
             mesh=mesh,
-            in_specs=(fat_spec, slots_spec, P(DATA_AXIS), P(DATA_AXIS, None)),
+            in_specs=(fat_spec, slots_spec, P(DATA_AXIS), P(DATA_AXIS, None),
+                      *(P() for _ in key_ops)),
             out_specs=(fat_spec, slots_spec),
             check_vma=False,
-        )(table, slots, ids_flat, grads_flat)
+        )(table, slots, ids_flat, grads_flat, *key_ops)
         return new_table, new_slots
 
     def a2a_overflow(self, tables: Mapping[str, jax.Array],
@@ -808,7 +838,10 @@ class ShardedEmbeddingCollection:
             else:
                 raise ValueError(f"unknown lookup mode {mode!r}")
             out[feat] = vecs
-        return out
+        # reads dequantize after the gather/exchange: activations are f32 at
+        # the model interface whatever the storage dtype (identity for f32,
+        # including every grouped_lookup output already cast inside)
+        return {f: v.astype(jnp.float32) for f, v in out.items()}
 
     def _lookup_hotcold(self, tables, feat: str, ids: jax.Array, mode: str):
         """Routed lookup for a hot/cold table: gather both sides (row
@@ -1050,7 +1083,10 @@ class ShardedEmbeddingCollection:
                 back = jax.lax.all_to_all(
                     vec.reshape(m, -1, vec.shape[-1]), axis,
                     split_axis=0, concat_axis=0)
-                flat = back.reshape(-1, vec.shape[-1])
+                # dequantize AFTER the exchange: the all_to_all payload rides
+                # at storage dtype (half the bytes for bf16 tables); the
+                # model always sees f32 activations (identity for f32)
+                flat = back.reshape(-1, vec.shape[-1]).astype(jnp.float32)
                 outv = jnp.where(
                     (slot_inv_l >= 0)[:, None],
                     jnp.take(flat, jnp.maximum(slot_inv_l, 0), axis=0), 0)
@@ -1083,7 +1119,7 @@ class ShardedEmbeddingCollection:
             else P()
             for leaf in slots)
 
-    def grouped_update(self, opt, tables, slots, ids, grads):
+    def grouped_update(self, opt, tables, slots, ids, grads, sr_key=None):
         """The backward half of the grouped exchange: ship each group's
         (virtual id, grad) stream to the owner shards with ONE id + ONE
         grad ``all_to_all``, then dedupe + apply the sparse optimizer on
@@ -1102,7 +1138,14 @@ class ShardedEmbeddingCollection:
 
         ``ids``/``grads`` map feature name -> raw ids / [..., D] grads.
         Returns ``(new_tables, new_slots)`` dicts covering the plan's
-        arrays only."""
+        arrays only.
+
+        ``sr_key``: base stochastic-rounding key for the step (narrow
+        storage only; ``None`` keeps the f32 call graph unchanged).  Each
+        array folds its stable ``quant.table_id`` plus the model-axis
+        index, so no two arrays — and no two shards — share rounding
+        bits."""
+        from tdfo_tpu.ops.quant import table_id
         from tdfo_tpu.ops.sparse import dedupe_grads, fat_update
 
         plan = self._grouped_plan(tuple(ids))
@@ -1133,8 +1176,10 @@ class ShardedEmbeddingCollection:
             def local_upd(tabs_l, slots_l, *parts, _g=g, _feat_rps=feat_rps,
                           _mds=mds, _cap=cap):
                 k = len(_g.feats)
+                key_l = parts[2 * k] if len(parts) > 2 * k else None
+                g_parts = parts[k:2 * k]
                 owner, virt = self._owner_virt(parts[:k], _feat_rps)
-                gcat = (jnp.concatenate(parts[k:]) if k > 1 else parts[k])
+                gcat = (jnp.concatenate(g_parts) if k > 1 else g_parts[0])
                 n = owner.shape[0]
                 iota = jnp.arange(n, dtype=jnp.int32)
                 sorted_owner, sorted_virt, order = jax.lax.sort(
@@ -1156,26 +1201,31 @@ class ShardedEmbeddingCollection:
                     send_g, axis, split_axis=0, concat_axis=0
                 ).reshape(-1, gcat.shape[-1])
                 out_t, out_s = [], []
-                for shard, sl, spec, rps, base, md in zip(
-                        tabs_l, slots_l, _g.specs, _g.rows_per_shard,
-                        _g.bases, _mds):
+                for aname, shard, sl, spec, rps, base, md in zip(
+                        _g.arrays, tabs_l, slots_l, _g.specs,
+                        _g.rows_per_shard, _g.bases, _mds):
                     loc = recv_ids - base
                     mine = (recv_ids >= 0) & (loc >= 0) & (loc < rps)
                     mids = jnp.where(mine, loc, -1)
                     mg = jnp.where(mine[:, None], recv_g, 0)
+                    sk = None
+                    if key_l is not None:
+                        sk = jax.random.fold_in(key_l, table_id(aname))
+                        sk = jax.random.fold_in(sk, jax.lax.axis_index(axis))
                     if spec.fused:
                         nt, ns = fat_update(
                             shard, sl, mids, mg, embedding_dim=_g.dim,
                             kind=self.fused_kind, lr=opt.lr, b1=opt.b1,
                             b2=opt.b2, eps=opt.eps,
                             weight_decay=opt.weight_decay,
-                            capacity=md, max_distinct=md)
+                            capacity=md, max_distinct=md, sr_key=sk)
                     else:
                         uids, gu, valid = dedupe_grads(
                             mids, mg, capacity=md, vocab=rps,
                             max_distinct=md)
                         nt, ns = opt.update_unique(
-                            shard, sl, uids, gu, valid, embedding_dim=_g.dim)
+                            shard, sl, uids, gu, valid, embedding_dim=_g.dim,
+                            sr_key=sk)
                     out_t.append(nt)
                     out_s.append(ns)
                 return tuple(out_t), tuple(out_s)
@@ -1183,14 +1233,16 @@ class ShardedEmbeddingCollection:
             tab_specs = tuple(P(axis, *([None] * (t.ndim - 1))) for t in tabs)
             slot_specs = tuple(self._grouped_slot_specs(t, sl)
                                for t, sl in zip(tabs, slot_in))
+            key_ops = () if sr_key is None else (sr_key,)
             upd_t, upd_s = shard_map(
                 local_upd, mesh=self.mesh,
                 in_specs=(tab_specs, slot_specs,
                           *(P(axis) for _ in flats),
-                          *(P(axis, None) for _ in gflats)),
+                          *(P(axis, None) for _ in gflats),
+                          *(P() for _ in key_ops)),
                 out_specs=(tab_specs, slot_specs),
                 check_vma=False,
-            )(tabs, slot_in, *flats, *gflats)
+            )(tabs, slot_in, *flats, *gflats, *key_ops)
             for a, nt, ns in zip(g.arrays, upd_t, upd_s):
                 new_tables[a] = nt
                 new_slots[a] = ns

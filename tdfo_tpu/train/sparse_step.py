@@ -25,8 +25,20 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from tdfo_tpu.ops.quant import sr_key as _make_sr_key
 from tdfo_tpu.ops.sparse import SparseOptimizer, dedupe_ids
 from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+
+
+def _array_is_narrow(state: "SparseTrainState", aname: str) -> bool:
+    """True when ``aname``'s table or any optimizer slot is stored narrow
+    (bf16): the signal that its update needs a stochastic-rounding key.
+    Static under jit (dtypes are trace-time constants), so f32 arrays keep
+    a key-free — hence byte-identical — update graph."""
+    if state.tables[aname].dtype == jnp.bfloat16:
+        return True
+    return any(leaf.dtype == jnp.bfloat16
+               for leaf in jax.tree_util.tree_leaves(state.slots[aname]))
 
 __all__ = [
     "SparseTrainState",
@@ -160,6 +172,7 @@ def make_sparse_train_step(
         f for f in features
         if coll.resolve(f)[1].sharding in ("row", "table")
     ) if use_grouped else ()
+    grouped_arrays = tuple(sorted({coll.resolve(f)[0] for f in grouped_feats}))
     by_table_static: dict[str, list[str]] = {}
     for f in features:
         if f in full_hot_feats or f in grouped_feats:
@@ -217,7 +230,8 @@ def make_sparse_train_step(
             if hp is None:
                 return cold_vec
             hot = state.tables[coll.hot_array_name(feat_table[f])]
-            hot_vec = jnp.take(hot, jnp.maximum(hp, 0), axis=0)
+            hot_vec = jnp.take(
+                hot, jnp.maximum(hp, 0), axis=0).astype(jnp.float32)
             if cold_vec is None:  # fully hot: there is no cold side
                 return hot_vec
             return jnp.where((hp >= 0)[..., None], hot_vec, cold_vec)
@@ -291,6 +305,9 @@ def make_sparse_train_step(
                     rows = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
                     dedup_ctx[tname] = ("rows", uids, seg, valid)
                 off = 0
+                # dequantize after the compact gather (identity for f32):
+                # the model interface is f32 whatever the storage dtype
+                rows = rows.astype(jnp.float32)
                 for f, n_f in zip(feats, sizes):
                     e = jnp.take(rows, seg[off:off + n_f], axis=0)
                     e = e.reshape(*ids[f].shape, e.shape[-1])
@@ -312,16 +329,28 @@ def make_sparse_train_step(
         updates, new_opt_state = state.tx.update(g_dense, state.opt_state, state.dense_params)
         new_dense = optax.apply_updates(state.dense_params, updates)
 
-        # sparse half: group features by table, one row-sparse update each
+        # sparse half: group features by table, one row-sparse update each.
+        # _sr_key: stochastic-rounding key per narrow-storage array, derived
+        # from (state.step, array name) — bit-deterministic, resume-exact —
+        # and None for f32 arrays (their update graph stays key-free)
+        def _sr_key(aname):
+            return (_make_sr_key(state.step, aname)
+                    if _array_is_narrow(state, aname) else None)
+
         new_tables = dict(state.tables)
         new_slots = dict(state.slots)
         if grouped_feats:
             # one grouped backward exchange for every row/table-sharded
-            # feature: 2 collectives total (ids + grads) vs 2 per array
+            # feature: 2 collectives total (ids + grads) vs 2 per array.
+            # One base key serves the whole exchange (grouped_update folds
+            # per-array table ids itself)
+            g_narrow = any(_array_is_narrow(state, a) for a in grouped_arrays)
             gt, gs = coll.grouped_update(
                 state.sparse_opt, state.tables, state.slots,
                 {f: ids[f] for f in grouped_feats},
-                {f: g_embs[f] for f in grouped_feats})
+                {f: g_embs[f] for f in grouped_feats},
+                sr_key=(_make_sr_key(state.step, "__grouped_update__")
+                        if g_narrow else None))
             new_tables.update(gt)
             new_slots.update(gs)
         for tname, feats in by_table_static.items():
@@ -357,7 +386,7 @@ def make_sparse_train_step(
                         state.sparse_opt.update_routed(
                             state.tables[tname], state.slots[tname], ulines,
                             g_u, row_lidx, row_slot, lines,
-                            embedding_dim=d_t,
+                            embedding_dim=d_t, sr_key=_sr_key(tname),
                         ))
                     continue
                 _, uids, seg, valid = ctx
@@ -367,7 +396,7 @@ def make_sparse_train_step(
                 g_u = jnp.where(valid[:, None], g_u, 0.0)
                 new_tables[tname], new_slots[tname] = state.sparse_opt.update_unique(
                     state.tables[tname], state.slots[tname], uids, g_u, valid,
-                    embedding_dim=d_t,
+                    embedding_dim=d_t, sr_key=_sr_key(tname),
                 )
                 continue
             all_ids, _, bound = _concat_ids(feats, cold_ids)
@@ -382,7 +411,7 @@ def make_sparse_train_step(
             new_tables[tname], new_slots[tname] = coll.sparse_update(
                 state.sparse_opt, tname,
                 state.tables[tname], state.slots[tname], all_ids, all_grads,
-                max_distinct=md,
+                max_distinct=md, sr_key=_sr_key(tname),
             )
 
         # hot-head updates: per logical table, ONE one-hot MXU contraction
@@ -400,6 +429,7 @@ def make_sparse_train_step(
             ])
             new_tables[hname], new_slots[hname] = state.sparse_opt.dense_update(
                 state.tables[hname], state.slots[hname], hp_all, g_all,
+                sr_key=_sr_key(hname),
             )
 
         return (
@@ -484,6 +514,7 @@ def make_pipelined_sparse_train_step(
     takes_rng = "dropout_rng" in inspect.signature(forward).parameters
     grouped_feats = tuple(
         f for f in features if coll.resolve(f)[1].sharding in ("row", "table"))
+    grouped_arrays = tuple(sorted({coll.resolve(f)[0] for f in grouped_feats}))
     rest_feats = tuple(f for f in features if f not in grouped_feats)
     by_table_rest: dict[str, list[str]] = {}
     for f in rest_feats:
@@ -524,12 +555,21 @@ def make_pipelined_sparse_train_step(
             g_dense, state.opt_state, state.dense_params)
         new_dense = optax.apply_updates(state.dense_params, updates)
 
+        # same SR keying as the eager step: state.step counts trained
+        # batches, so pipelining does not shift the key stream
+        def _sr_key(aname):
+            return (_make_sr_key(state.step, aname)
+                    if _array_is_narrow(state, aname) else None)
+
         new_tables = dict(state.tables)
         new_slots = dict(state.slots)
+        g_narrow = any(_array_is_narrow(state, a) for a in grouped_arrays)
         gt, gs = coll.grouped_update(
             state.sparse_opt, state.tables, state.slots,
             {f: ids[f] for f in grouped_feats},
-            {f: g_embs[f] for f in grouped_feats})
+            {f: g_embs[f] for f in grouped_feats},
+            sr_key=(_make_sr_key(state.step, "__grouped_update__")
+                    if g_narrow else None))
         new_tables.update(gt)
         new_slots.update(gs)
         for tname, feats in by_table_rest.items():
@@ -546,7 +586,7 @@ def make_pipelined_sparse_train_step(
             new_tables[tname], new_slots[tname] = coll.sparse_update(
                 state.sparse_opt, tname,
                 state.tables[tname], state.slots[tname], all_ids, all_grads,
-                max_distinct=md,
+                max_distinct=md, sr_key=_sr_key(tname),
             )
 
         new_state = SparseTrainState(

@@ -30,6 +30,7 @@ all of this is testable (``tdfo_tpu/utils/faults.py``).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import math
 import time
@@ -471,6 +472,14 @@ class Trainer:
             specs = ctr_embedding_specs(
                 cfg.size_map, cfg.embed_dim, sharding,
                 fused_threshold=cfg.effective_fused_threshold)
+        # storage dtype is a per-table property of the spec; the collection,
+        # kernels and optimizer all read it from spec.dtype downstream
+        specs = [
+            dataclasses.replace(
+                s, dtype=jnp.dtype(cfg.embeddings.dtype_for(s.name))
+            )
+            for s in specs
+        ]
         hot_ids = None
         if cfg.embeddings.hot_vocab > 0:
             from tdfo_tpu.data.hot_ids import load_hot_ids
@@ -498,10 +507,20 @@ class Trainer:
         )
         # hot/cold checkpoints are only loadable under the SAME hot sets —
         # stamp the digests into the checkpoint sidecar so a mismatched
-        # restore refuses instead of silently mis-routing rows
-        self._ckpt_stamps = (
-            {"hot_ids": coll.hot_digest()} if coll.hot_ids else None
-        )
+        # restore refuses instead of silently mis-routing rows.  Same for
+        # storage dtypes: a bf16-stored table restored into an f32 run (or
+        # vice versa) would silently change every subsequent update, so the
+        # stamp pins them.  Defaults-only runs keep the stamp absent — their
+        # sidecars stay byte-compatible with pre-dtype checkpoints.
+        stamps: dict[str, Any] = {}
+        if coll.hot_ids:
+            stamps["hot_ids"] = coll.hot_digest()
+        tstamp = {s.name: jnp.dtype(s.dtype).name for s in specs}
+        if (any(v != "float32" for v in tstamp.values())
+                or cfg.embeddings.slot_dtype != "float32"):
+            stamps["table_dtype"] = tstamp
+            stamps["slot_dtype"] = cfg.embeddings.slot_dtype
+        self._ckpt_stamps = stamps or None
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)
         if cfg.model == "dlrm":
@@ -529,6 +548,7 @@ class Trainer:
             sparse_opt=sparse_optimizer(
                 cfg.sparse_optimizer, lr=cfg.learning_rate,
                 weight_decay=cfg.weight_decay,
+                slot_dtype=cfg.embeddings.slot_dtype,
             ),
         ), self.mesh)
         if cfg.train.pipeline_overlap:

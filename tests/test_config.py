@@ -319,3 +319,59 @@ def test_serving_knobs_observable():
             mb.submit(i, {"x": np.arange(4)})
         mb.drain()
         assert len(mb.shipped) == batches
+
+
+def test_embeddings_dtype_table(tmp_path: Path):
+    """[embeddings] table_dtype / slot_dtype / per-table overrides round-trip
+    from toml; defaults stay float32 (byte-identical unquantized storage)."""
+    cfg = read_configs()
+    assert cfg.embeddings.table_dtype == "float32"
+    assert cfg.embeddings.slot_dtype == "float32"
+    assert cfg.embeddings.table_dtype_overrides == ()
+    (tmp_path / "config.toml").write_text(
+        'model = "dlrm"\n'
+        "[embeddings]\n"
+        'table_dtype = "bfloat16"\n'
+        'slot_dtype = "bfloat16"\n'
+        "[embeddings.table_dtype_overrides]\n"
+        'user = "float32"\n')
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.embeddings.table_dtype == "bfloat16"
+    assert cfg.embeddings.slot_dtype == "bfloat16"
+    assert cfg.embeddings.dtype_for("user") == "float32"
+    assert cfg.embeddings.dtype_for("item") == "bfloat16"
+    hash(cfg.embeddings)  # overrides normalise to a tuple: spec stays hashable
+
+
+def test_embeddings_dtype_validation():
+    from tdfo_tpu.core.config import EmbeddingsSpec
+
+    # unknown dtype strings rejected wherever they appear
+    with pytest.raises(ValueError, match="table_dtype"):
+        Config(model="dlrm", embeddings=EmbeddingsSpec(table_dtype="fp8"))
+    with pytest.raises(ValueError, match="slot_dtype"):
+        Config(model="dlrm", embeddings=EmbeddingsSpec(slot_dtype="float16"))
+    with pytest.raises(ValueError, match="table_dtype_overrides"):
+        Config(model="dlrm", embeddings=EmbeddingsSpec(
+            table_dtype_overrides={"user": "int8"}))
+    # rowwise_adagrad keeps its f32 per-row accumulator: bf16 slots refused
+    with pytest.raises(ValueError, match="rowwise_adagrad"):
+        Config(model="dlrm", sparse_optimizer="rowwise_adagrad",
+               embeddings=EmbeddingsSpec(slot_dtype="bfloat16"))
+    # the knob configures the DMP sparse regime only
+    with pytest.raises(ValueError, match="DMP"):
+        Config(model="bert4rec",
+               embeddings=EmbeddingsSpec(table_dtype="bfloat16"))
+    with pytest.raises(ValueError, match="DMP"):
+        Config(model="twotower", model_parallel=False,
+               embeddings=EmbeddingsSpec(table_dtype="bfloat16"))
+    # valid combinations construct fine
+    Config(model="dlrm", embeddings=EmbeddingsSpec(
+        table_dtype="bfloat16", slot_dtype="bfloat16"))
+    Config(model="twotower", model_parallel=True,
+           embeddings=EmbeddingsSpec(
+               table_dtype="bfloat16",
+               table_dtype_overrides={"user": "float32"}))
+    # table bf16 with f32 slots is the rowwise-compatible combination
+    Config(model="dlrm", sparse_optimizer="rowwise_adagrad",
+           embeddings=EmbeddingsSpec(table_dtype="bfloat16"))

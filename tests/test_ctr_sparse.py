@@ -258,3 +258,36 @@ def test_fused_sparse_state_checkpoint_resume(ctr_data, tmp_path):
     m2 = tr2.fit()
     assert 0.0 <= m2["auc"] <= 1.0
     assert m2["eval_loss"] <= m1["eval_loss"] * 1.2
+
+
+@pytest.mark.slow  # full fit (~17 s); tier-1 keeps the test_quant_storage
+# unit coverage, this end-to-end run rides the slow tier for budget
+def test_bf16_storage_through_trainer(ctr_data, tmp_path):
+    """[embeddings] dtype knobs observable end to end: tables (minus the
+    per-table override) and adam slots come up bf16, the checkpoint sidecar
+    stamps both dtypes, and training still converges to a sane AUC."""
+    from tdfo_tpu.train.trainer import Trainer
+
+    d, size_map = ctr_data
+    cfg = _trainer_cfg(
+        d, size_map, model="twotower", model_parallel=True,
+        mesh={"data": 4, "model": 2},
+        embeddings={"table_dtype": "bfloat16", "slot_dtype": "bfloat16",
+                    "table_dtype_overrides": {"user_embed": "float32"}},
+    )
+    tr = Trainer(cfg, log_dir=tmp_path)
+    assert tr.state.tables["user_embed"].dtype == jnp.float32  # override
+    others = [n for n in tr.state.tables if n != "user_embed"]
+    assert others and all(
+        tr.state.tables[n].dtype == jnp.bfloat16 for n in others)
+    # adam mu/nu slots follow slot_dtype on the bf16 tables
+    for n in others:
+        assert tr.state.slots[n][0].dtype == jnp.bfloat16, n
+    stamps = tr._ckpt_stamps
+    assert stamps["slot_dtype"] == "bfloat16"
+    assert stamps["table_dtype"]["user_embed"] == "float32"
+    assert all(v == "bfloat16" for k, v in stamps["table_dtype"].items()
+               if k != "user_embed")
+    metrics = tr.fit()
+    assert 0.0 <= metrics["auc"] <= 1.0
+    assert metrics["eval_loss"] > 0

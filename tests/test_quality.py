@@ -104,3 +104,34 @@ def test_no_default_method_searchsorted_in_hot_code():
     assert not offenders, (
         "jnp.searchsorted without method='sort' (TPU-hostile default): "
         + ", ".join(offenders))
+
+
+def test_no_precisionless_dots_in_kernel_code():
+    """f32 `dot_general` INSIDE Mosaic kernels silently runs bf16 passes at
+    default precision (~1e-3 rel error — enough to poison optimizer state;
+    CLAUDE.md measured fact).  Every dot in ops/pallas_kernels.py must state
+    its precision explicitly: HIGHEST where exactness matters, an explicit
+    DEFAULT where bf16 MXU passes are the intent (the flash-attention dots).
+    Implicit precision is how the bug comes back."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    path = Path(tdfo_tpu.__file__).parent / "ops" / "pallas_kernels.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    offenders = []
+    n_dots = 0
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("dot_general", "dot")):
+            continue
+        n_dots += 1
+        if "precision" not in {k.arg for k in node.keywords}:
+            offenders.append(f"{path.name}:{node.lineno}")
+    assert n_dots > 0  # the rule must actually be scanning something
+    assert not offenders, (
+        "dot_general/dot without explicit precision= in kernel code "
+        "(default precision runs bf16 passes on f32 operands): "
+        + ", ".join(offenders))

@@ -1,0 +1,426 @@
+"""Quantized embedding storage: bf16 tables + compressed optimizer slots
+with stochastic rounding (fbgemm quantized-TBE / intra-training embedding
+quantization parity).
+
+The storage-dtype contract under test:
+
+* tables and slots are STORED at the spec/slot dtype and COMPUTED in f32 —
+  reads dequantize after the row gather, writes requantize through
+  stochastic rounding keyed by a counter-derived threefry key folded from
+  ``(state.step, table)``.  Same state + same batch => bitwise-identical
+  update, on a fresh process too (kill/restart-identity rides on PR-1's
+  step-granular resume).
+* ``float32`` defaults stay KEY-FREE: quantize is the identity and no PRNG
+  enters the graph, so default builds are byte-identical to the
+  unquantized program.
+* the grouped all-to-all exchanges vectors at STORAGE dtype (half the
+  payload bytes for bf16) and never concatenates tables of different
+  dtypes into one stream.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tdfo_tpu.ops.quant import (
+    component_key,
+    quantize,
+    sr_key,
+    stochastic_round,
+)
+from tdfo_tpu.ops.sparse import sparse_optimizer
+from tdfo_tpu.parallel.embedding import EmbeddingSpec, ShardedEmbeddingCollection
+from tdfo_tpu.train.metrics import AUC
+from tdfo_tpu.train.sparse_step import SparseTrainState, make_sparse_train_step
+
+B, D = 64, 8
+
+
+# ------------------------------------------------------------ quant unit
+
+
+class TestStochasticRound:
+    def test_identity_on_representable(self):
+        """Values already exactly representable in bf16 must survive SR
+        bit-for-bit under ANY key — this is what lets untouched rows ride a
+        whole-block requantize without drift."""
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(256,)),
+                        jnp.float32).astype(jnp.bfloat16).astype(jnp.float32)
+        want = x.astype(jnp.bfloat16)
+        for s in range(3):
+            got = stochastic_round(x, jnp.bfloat16, jax.random.PRNGKey(s))
+            np.testing.assert_array_equal(
+                np.asarray(got).view(np.uint16), np.asarray(want).view(np.uint16))
+
+    def test_rounds_to_neighbours_unbiased(self):
+        """A value a quarter of the way between two bf16 neighbours lands on
+        one of exactly those two, low-side ~75% of the time."""
+        # bf16 (7 mantissa bits) neighbours of 1.0 are 1.0 and 1 + 2^-7
+        lo, hi = 1.0, 1.0 + 2.0 ** -7
+        v = lo + 0.25 * (hi - lo)
+        n = 200_000
+        x = jnp.full((n,), v, jnp.float32)
+        out = np.asarray(stochastic_round(
+            x, jnp.bfloat16, jax.random.PRNGKey(7)), dtype=np.float32)
+        assert set(np.unique(out)) <= {lo, hi}
+        p_hi = (out == hi).mean()
+        # binomial std of the mean at p=0.25 over 200k draws ≈ 0.001
+        assert abs(p_hi - 0.25) < 0.006, p_hi
+
+    def test_deterministic_and_key_sensitive(self):
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(512,)),
+                        jnp.float32)
+        a = stochastic_round(x, jnp.bfloat16, jax.random.PRNGKey(3))
+        b = stochastic_round(x, jnp.bfloat16, jax.random.PRNGKey(3))
+        c = stochastic_round(x, jnp.bfloat16, jax.random.PRNGKey(4))
+        np.testing.assert_array_equal(np.asarray(a).view(np.uint16),
+                                      np.asarray(b).view(np.uint16))
+        assert (np.asarray(a).view(np.uint16)
+                != np.asarray(c).view(np.uint16)).any()
+
+    def test_quantize_f32_and_keyless_paths(self):
+        """quantize to f32 is the identity (key or not); bf16 without a key
+        is plain round-to-nearest — the deterministic eval/export path."""
+        x = jnp.asarray(np.random.default_rng(2).normal(size=(64,)),
+                        jnp.float32)
+        same = quantize(x, jnp.float32, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(same).view(np.uint32),
+                                      np.asarray(x).view(np.uint32))
+        np.testing.assert_array_equal(
+            np.asarray(quantize(x, jnp.bfloat16)).view(np.uint16),
+            np.asarray(x.astype(jnp.bfloat16)).view(np.uint16))
+
+    def test_sr_key_stream(self):
+        """One key per (step, table), derived from the resume-surviving step
+        counter: deterministic across processes, distinct across both axes."""
+        k = lambda s, n: np.asarray(jax.random.key_data(sr_key(s, n)))
+        np.testing.assert_array_equal(k(3, "user"), k(3, "user"))
+        assert (k(3, "user") != k(4, "user")).any()
+        assert (k(3, "user") != k(3, "item")).any()
+        assert component_key(None, 1) is None
+        ck = sr_key(0, "t")
+        assert (np.asarray(jax.random.key_data(component_key(ck, 0)))
+                != np.asarray(jax.random.key_data(component_key(ck, 1)))).any()
+
+
+# ---------------------------------------------------- storage semantics
+
+
+def _qspecs(n_tables, dtype, dim=D):
+    return [
+        EmbeddingSpec(name=f"t{i}", num_embeddings=40 + 9 * i,
+                      embedding_dim=dim, features=(f"f{i}",),
+                      sharding="row", init_scale=0.1, dtype=dtype)
+        for i in range(n_tables)
+    ]
+
+
+def _qcoll(mesh, dtype, n_tables=3, *, grouped=True):
+    return ShardedEmbeddingCollection(
+        _qspecs(n_tables, dtype), mesh=mesh, grouped_a2a=grouped,
+        fused_kind="adam",
+    )
+
+
+def _qfeats(mesh, n_tables=3, b=B, key=1):
+    k = jax.random.PRNGKey(key)
+    return {
+        f"f{i}": jax.device_put(
+            jax.random.randint(jax.random.fold_in(k, i), (b,), 0, 40),
+            NamedSharding(mesh, P("model")))
+        for i in range(n_tables)
+    }
+
+
+def test_tables_and_slots_stored_narrow(mesh8):
+    coll = _qcoll(mesh8, jnp.bfloat16)
+    tables = coll.init(jax.random.PRNGKey(0))
+    for a, t in tables.items():
+        assert t.dtype == jnp.bfloat16, a
+        assert t.nbytes == t.size * 2, a  # half the f32 footprint
+    opt = sparse_optimizer("adam", lr=1e-2, slot_dtype="bfloat16")
+    slots = opt.init(jnp.zeros((40, D), jnp.bfloat16))
+    assert slots[0].dtype == slots[1].dtype == jnp.bfloat16  # mu, nu
+    # the rowwise accumulator is contractually f32 whatever slot_dtype says
+    # (fbgemm EXACT_ROWWISE_ADAGRAD keeps a full-precision per-row count)
+    row = sparse_optimizer("rowwise_adagrad", lr=1e-2, slot_dtype="bfloat16")
+    assert row.init(jnp.zeros((40, D), jnp.bfloat16))[0].dtype == jnp.float32
+    # reads dequantize AFTER the gather: lookup ships f32 activations
+    embs = jax.jit(lambda t, f: coll.lookup(t, f, mode="alltoall"))(
+        tables, _qfeats(mesh8))
+    assert all(e.dtype == jnp.float32 for e in embs.values())
+
+
+def test_grouped_exchange_carries_bf16_payload(mesh8):
+    """The vector all_to_all moves bf16 — the bandwidth claim, pinned in
+    the jaxpr; id exchange stays int32 and the op count stays 2."""
+    coll = _qcoll(mesh8, jnp.bfloat16)
+    tables = coll.init(jax.random.PRNGKey(0))
+    j = str(jax.make_jaxpr(
+        lambda t, f: coll.lookup(t, f, mode="alltoall"))(
+            tables, _qfeats(mesh8)))
+    a2a_lines = [ln for ln in j.splitlines() if "all_to_all" in ln]
+    assert len(a2a_lines) == 2, j
+    assert any("bf16[" in ln for ln in a2a_lines), a2a_lines
+
+
+def test_mixed_dtype_tables_never_share_a_stream(mesh8):
+    """Satellite: grouping keys on (dim, dtype).  bf16 and f32 tables of the
+    same dim ride SEPARATE exchanges (2 each) and the forward stays bitwise
+    equal to the per-table program."""
+    specs = _qspecs(2, jnp.bfloat16) + [
+        dataclasses.replace(s, name=f"g{i}", features=(f"h{i}",))
+        for i, s in enumerate(_qspecs(2, jnp.float32))
+    ]
+    mk = lambda grouped: ShardedEmbeddingCollection(
+        specs, mesh=mesh8, grouped_a2a=grouped, fused_kind="adam")
+    grouped, per_table = mk(True), mk(False)
+    tables = grouped.init(jax.random.PRNGKey(0))
+    feats = dict(_qfeats(mesh8, 2))
+    feats.update({f"h{i}": feats[f"f{i}"] for i in range(2)})
+    j = str(jax.make_jaxpr(
+        lambda t, f: grouped.lookup(t, f, mode="alltoall"))(tables, feats))
+    assert j.count("all_to_all") == 4, j.count("all_to_all")
+    lk_g = jax.jit(lambda t, f: grouped.lookup(t, f, mode="alltoall"))(
+        tables, feats)
+    lk_p = jax.jit(lambda t, f: per_table.lookup(t, f, mode="alltoall"))(
+        tables, feats)
+    for f in feats:
+        np.testing.assert_array_equal(
+            np.asarray(lk_g[f]), np.asarray(lk_p[f]), err_msg=f)
+
+
+def test_grouped_update_bf16_matches_sequential_reference(mesh8):
+    """Keyless (round-to-nearest) bf16 grouped update == the sequential
+    per-table reference bitwise: identical f32 math, identical final
+    requantize."""
+    coll = _qcoll(mesh8, jnp.bfloat16)
+    tables = coll.init(jax.random.PRNGKey(0))
+    opt = sparse_optimizer("adam", lr=1e-2, slot_dtype="bfloat16")
+    slots = {a: opt.init(t) for a, t in tables.items()}
+    feats = _qfeats(mesh8)
+    k = jax.random.PRNGKey(9)
+    grads = {
+        f: jax.device_put(
+            jax.random.normal(jax.random.fold_in(k, i), (B, D)),
+            NamedSharding(mesh8, P("model", None)))
+        for i, f in enumerate(feats)
+    }
+    ref_t = {a: jnp.asarray(np.asarray(t)) for a, t in tables.items()}
+    ref_s = {a: tuple(jnp.asarray(np.asarray(x)) for x in s)
+             for a, s in slots.items()}
+    for f in feats:
+        aname, spec, off = coll.resolve(f)
+        ids = jnp.asarray(np.asarray(feats[f])) + off
+        ref_t[aname], ref_s[aname] = opt.update(
+            ref_t[aname], ref_s[aname], ids,
+            jnp.asarray(np.asarray(grads[f])), embedding_dim=D)
+    got_t, got_s = jax.jit(
+        lambda t, s, i, g: coll.grouped_update(opt, t, s, i, g)
+    )(tables, slots, feats, grads)
+    for a in got_t:
+        assert got_t[a].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(ref_t[a]).view(np.uint16),
+            np.asarray(got_t[a]).view(np.uint16), err_msg=a)
+        for x, y in zip(ref_s[a], got_s[a]):
+            np.testing.assert_array_equal(np.asarray(x, np.float32),
+                                          np.asarray(y, np.float32))
+
+
+# ------------------------------------------------------------ trajectory
+
+
+def _label_fn(ids):
+    return (np.asarray(ids) < 20).astype(np.float32)
+
+
+def _traj_batches(n, seed=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        b = {f"f{i}": jnp.asarray(rng.integers(0, 40, B), jnp.int32)
+             for i in range(3)}
+        b["label"] = jnp.asarray(_label_fn(b["f0"]))
+        out.append(b)
+    return out
+
+
+def _traj_forward(dense, embs, batch):
+    logits = sum(e @ dense["w"] for e in embs.values())
+    return optax.sigmoid_binary_cross_entropy(logits, batch["label"]).mean()
+
+
+_TRAJ_LR = {"sgd": 1.0, "adagrad": 0.5, "rowwise_adagrad": 0.5, "adam": 0.3}
+
+
+def _run_traj(mesh, dtype, kind, n_steps=32):
+    coll = _qcoll(mesh, dtype)
+    slot_dtype = ("float32" if (kind == "rowwise_adagrad"
+                                or dtype == jnp.float32) else "bfloat16")
+    step = make_sparse_train_step(
+        coll, _traj_forward, mode="alltoall", donate=False)
+    state = SparseTrainState.create(
+        # nonzero dense read-out so the embeddings see gradient from step 0
+        dense_params={"w": jnp.full((D,), 0.3)},
+        tx=optax.adam(3e-2),
+        tables=coll.init(jax.random.PRNGKey(0)),
+        sparse_opt=sparse_optimizer(kind, lr=_TRAJ_LR[kind],
+                                    slot_dtype=slot_dtype),
+    )
+    bs = _traj_batches(8)
+    losses = []
+    for s in range(n_steps):
+        state, l = step(state, bs[s % len(bs)])
+        losses.append(float(l))
+    # held-out AUC
+    hb = _traj_batches(4, seed=77)
+    auc = AUC.empty(200)
+    lookup = jax.jit(lambda t, f: coll.lookup(t, f, mode="alltoall"))
+    for b in hb:
+        embs = lookup(state.tables, {f: b[f] for f in coll.features()})
+        logits = sum(np.asarray(e) @ np.asarray(state.dense_params["w"])
+                     for e in embs.values())
+        auc = auc.update(b["label"], jax.nn.sigmoid(jnp.asarray(logits)))
+    return float(auc.result()), losses, state
+
+
+@pytest.mark.parametrize("kind", ["sgd", "adagrad", "rowwise_adagrad", "adam"])
+def test_bf16_sr_training_tracks_f32(mesh8, kind):
+    """The headline quality claim on every EmbOptimType kind: bf16 tables
+    (+ bf16 slots where the kind permits) with stochastic rounding reach
+    held-out AUC within tolerance of the f32 run on a learnable synthetic
+    CTR task."""
+    auc_f32, losses_f32, _ = _run_traj(mesh8, jnp.float32, kind)
+    auc_bf16, losses_bf16, _ = _run_traj(mesh8, jnp.bfloat16, kind)
+    assert losses_f32[-1] < losses_f32[0], losses_f32
+    assert losses_bf16[-1] < losses_bf16[0], losses_bf16
+    assert auc_f32 > 0.75, (kind, auc_f32)
+    assert abs(auc_f32 - auc_bf16) < 0.08, (kind, auc_f32, auc_bf16)
+
+
+def test_bf16_sr_bit_deterministic_and_resume_identical(mesh8):
+    """SR keys come from (state.step, table) only: two fresh runs of the
+    same batches are bitwise identical, and a kill/restart after step 2
+    (state round-tripped through host memory, step fn rebuilt — the PR-1
+    resume path) replays into the SAME bits as the uninterrupted run."""
+    coll = _qcoll(mesh8, jnp.bfloat16)
+    bs = _traj_batches(4)
+
+    def fresh_state():
+        return SparseTrainState.create(
+            dense_params={"w": jnp.full((D,), 0.3)},
+            tx=optax.adam(1e-2),
+            tables=coll.init(jax.random.PRNGKey(0)),
+            sparse_opt=sparse_optimizer("adam", lr=0.3,
+                                        slot_dtype="bfloat16"),
+        )
+
+    def run(step, state, batches):
+        for b in batches:
+            state, _ = step(state, b)
+        return state
+
+    step1 = make_sparse_train_step(coll, _traj_forward, mode="alltoall",
+                                   donate=False)
+    full_a = run(step1, fresh_state(), bs)
+    full_b = run(step1, fresh_state(), bs)
+    # interrupted run: host round-trip + a NEW step function mid-stream
+    half = run(step1, fresh_state(), bs[:2])
+    half = jax.tree_util.tree_map(lambda x: jnp.asarray(np.asarray(x)), half)
+    step2 = make_sparse_train_step(coll, _traj_forward, mode="alltoall",
+                                   donate=False)
+    resumed = run(step2, half, bs[2:])
+    assert int(resumed.step) == int(full_a.step) == len(bs)
+    for name, want in full_a.tables.items():
+        w16 = np.asarray(want).view(np.uint16)
+        np.testing.assert_array_equal(
+            w16, np.asarray(full_b.tables[name]).view(np.uint16),
+            err_msg=f"{name}: rerun not deterministic")
+        np.testing.assert_array_equal(
+            w16, np.asarray(resumed.tables[name]).view(np.uint16),
+            err_msg=f"{name}: resume diverged")
+
+
+def test_f32_default_update_graph_is_key_free(mesh8):
+    """float32 tables must never pay for the feature: no PRNG primitives in
+    the step jaxpr (threefry shows up the moment a key is threaded), so the
+    default program is the pre-quantization program."""
+    coll = _qcoll(mesh8, jnp.float32)
+    step = make_sparse_train_step(
+        coll, _traj_forward, mode="alltoall", donate=False, jit=False)
+    state = SparseTrainState.create(
+        dense_params={"w": jnp.zeros((D,))},
+        tx=optax.adam(1e-2),
+        tables=coll.init(jax.random.PRNGKey(0)),
+        sparse_opt=sparse_optimizer("adam", lr=0.3),
+    )
+    j = str(jax.make_jaxpr(step)(state, _traj_batches(1)[0]))
+    assert "bf16" not in j
+    assert not any(p in j for p in ("random_bits", "random_fold_in",
+                                    "random_seed"))
+    qc = _qcoll(mesh8, jnp.bfloat16)
+    qstep = make_sparse_train_step(
+        qc, _traj_forward, mode="alltoall", donate=False, jit=False)
+    qstate = SparseTrainState.create(
+        dense_params={"w": jnp.zeros((D,))},
+        tx=optax.adam(1e-2),
+        tables=qc.init(jax.random.PRNGKey(0)),
+        sparse_opt=sparse_optimizer("adam", lr=0.3, slot_dtype="bfloat16"),
+    )
+    qj = str(jax.make_jaxpr(qstep)(qstate, _traj_batches(1)[0]))
+    assert "random_bits" in qj and "bf16" in qj
+
+
+# ------------------------------------------------- checkpoint + export
+
+
+def test_dtype_stamps_refuse_mismatched_restore(tmp_path):
+    """A bf16-stored checkpoint must refuse to restore into an f32 run and
+    vice versa — restoring across storage dtypes would silently change
+    every subsequent update."""
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+
+    state = {"t": jnp.zeros((4, D), jnp.bfloat16)}
+    dstamp = {"table_dtype": {"t0": "bfloat16"}, "slot_dtype": "bfloat16"}
+    mgr = CheckpointManager(tmp_path / "q")
+    mgr.save(0, state, stamps=dstamp)
+    step, restored, _ = mgr.restore(state, stamps=dict(dstamp))
+    assert step == 0 and restored["t"].dtype == jnp.bfloat16
+    for bad in (None,                                      # f32-default run
+                {"table_dtype": {"t0": "float32"},         # dtype flipped
+                 "slot_dtype": "bfloat16"}):
+        with pytest.raises(ValueError, match="stamps"):
+            mgr.restore(state, stamps=bad)
+    mgr.close()
+    # f32-default checkpoint (no stamps) refused by a bf16 run
+    mgr2 = CheckpointManager(tmp_path / "q2")
+    mgr2.save(0, state)
+    with pytest.raises(ValueError, match="stamps"):
+        mgr2.restore(state, stamps=dict(dstamp))
+    mgr2.close()
+
+
+def test_export_upcasts_bf16_exactly(mesh8):
+    """Serving bundles stay f32 at the interface: merged_tables upcasts
+    bf16 rows exactly (every bf16 is representable in f32), so a
+    quantized-training run exports through the unchanged pipeline."""
+    from tdfo_tpu.serve.export import merged_tables
+
+    coll = _qcoll(mesh8, jnp.bfloat16, n_tables=2, grouped=False)
+    tables = coll.init(jax.random.PRNGKey(0))
+    out = merged_tables(coll, tables)
+    for i in range(2):
+        spec = coll.specs[f"t{i}"]
+        got = out[f"t{i}"]
+        assert got.dtype == np.float32
+        assert got.shape == (spec.num_embeddings, D)
+        aname, _, off = coll.resolve_table(f"t{i}")
+        want = np.asarray(jax.device_get(tables[aname]))[
+            off:off + spec.num_embeddings].astype(np.float32)
+        np.testing.assert_array_equal(got, want)
