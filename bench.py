@@ -683,6 +683,149 @@ def bench_big_table(vocab_tiny: int = 2_000_000, vocab_small: int = 50_000_000,
     return out
 
 
+def _sim_cache_hit_rate(vocab: int, batch: int, cache_rows: int,
+                        flush_every: int, steps: int = 192,
+                        seed: int = 1234) -> tuple[float, int]:
+    """Host-side replay of the update-cache directory policy (admit-all
+    misses, retain the hottest C//2 by (freq desc, recency desc, id) at
+    each flush, age retained frequencies //2 — ``ops/sparse.py``
+    cache_flush) under the same zipf a=1.2 traffic the timed chains see.
+    Returns ``(steady-state hit rate over the last half of the replay,
+    peak directory occupancy)`` — the peak validates that ``cache_rows``
+    really holds a flush interval's distinct ids (overflow means lost
+    updates, which the trainer treats as a hard error)."""
+    from tdfo_tpu.data.synthetic import zipf_ids
+
+    r = np.random.default_rng(seed)
+    keep_k = cache_rows // 2
+    dir_ids = np.empty((0,), np.int64)
+    freq: dict[int, int] = {}
+    last: dict[int, int] = {}
+    hits = total = peak = 0
+    for step in range(steps):
+        ids = zipf_ids(r, vocab, batch).astype(np.int64)
+        u, cnt = np.unique(ids, return_counts=True)
+        resident = np.isin(u, dir_ids)
+        if step >= steps // 2:
+            hits += int(cnt[resident].sum())
+            total += batch
+        dir_ids = np.union1d(dir_ids, u[~resident])
+        for i in u.tolist():
+            freq[i] = freq.get(i, 0) + 1
+            last[i] = step
+        peak = max(peak, len(dir_ids))
+        if (step + 1) % flush_every == 0:
+            retained = set(sorted(
+                dir_ids.tolist(),
+                key=lambda i: (-freq[i], -last[i], i))[:keep_k])
+            # evicted entries lose their counters (re-admission resets
+            # freq to 0, matching _cache_admit); retained ones age //2
+            freq = {i: f // 2 for i, f in freq.items() if i in retained}
+            last = {i: t for i, t in last.items() if i in retained}
+            dir_ids = np.asarray(sorted(retained), np.int64)
+    return hits / max(total, 1), peak
+
+
+def bench_cache_zipf(vocab: int = 10_131_227, dim: int = 16,
+                     batch: int = 8192, cache_rows: int = 131_072,
+                     kind: str = "rowwise_adagrad",
+                     flush_everies: tuple[int, ...] = (1, 8, 64),
+                     ks: tuple[int, int] = (64, 192), reps: int = 3) -> dict:
+    """Software MANAGED_CACHING amortization under power-law traffic: the
+    cached step (directory route + cache-resident update; the big table is
+    scattered into only on flush) vs the eager per-step dedupe + scatter,
+    on the largest Criteo-Kaggle table (10.13M x 16, rowwise-adagrad) at
+    zipf a=1.2 ids.  Emits the amortized ms/step at flush_every {1, 8, 64}
+    — chain lengths are multiples of every interval, so each chain carries
+    exactly k/flush_every coalesced flushes and the differencing amortizes
+    them exactly — plus the host-simulated steady-state hit rate of the
+    same retention policy.  flush_every=1 bounds the cache's overhead
+    (route + admit + flush every step); the win case is 8/64 vs
+    ``eager_ms``.  vs_eager > 1 = the cache wins."""
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.data.synthetic import zipf_ids
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer(kind, lr=1e-3)
+    out: dict[str, object] = {"vocab": vocab, "dim": dim, "batch": batch,
+                              "cache_rows": cache_rows, "optimizer": kind,
+                              "zipf_a": 1.2}
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        ids = jax.device_put(zipf_ids(r, vocab, (k, batch)))
+        grads = jax.device_put(r.standard_normal((k, batch, dim), np.float32))
+        float(jnp.sum(ids) + jnp.sum(grads))
+        return (ids, grads)
+
+    # eager baseline: the plain dedupe + XLA row-scatter step on the SAME
+    # power-law traffic (uniform ids would overstate the cache's win)
+    def run_eager(k):
+        @jax.jit
+        def chain(ids_stack, grads_stack):
+            table = jnp.zeros((vocab, dim), jnp.float32)
+            slots = opt.init(table)
+
+            def body(carry, xs):
+                t, s = carry
+                ids, g = xs
+                t, s = opt.update(t, s, ids, g)
+                return (t, s), None
+
+            (t, _), _ = jax.lax.scan(body, (table, slots),
+                                     (ids_stack, grads_stack))
+            return t[0].sum()
+
+        return chain
+
+    eager_sec = chain_time(run_eager, make_args, ks=ks, reps=reps)
+    out["eager_ms"] = round(eager_sec * 1e3, 3)
+
+    for fe in flush_everies:
+        def run_cached(k, fe=fe):
+            @jax.jit
+            def chain(ids_stack, grads_stack):
+                table = jnp.zeros((vocab, dim), jnp.float32)
+                slots = opt.init(table)
+                cache = opt.cache_init(table, cache_rows)
+
+                def body(carry, xs):
+                    t, s, c, step = carry
+                    ids, g = xs
+                    c, s = opt.cache_update(c, t, s, ids, g, step=step)
+
+                    def flush(a):
+                        c, t, s = a
+                        c, t, s, _ = opt.cache_flush(c, t, s)
+                        return c, t, s
+
+                    c, t, s = jax.lax.cond((step + 1) % fe == 0, flush,
+                                           lambda a: a, (c, t, s))
+                    return (t, s, c, step + 1), None
+
+                (t, _, c, _), _ = jax.lax.scan(
+                    body, (table, slots, cache, jnp.int32(0)),
+                    (ids_stack, grads_stack))
+                # keep the table, the cache AND the overflow counter live
+                return (t[0].sum() + c["rows"][0].sum()
+                        + c["over"].astype(jnp.float32))
+
+            return chain
+
+        sec = chain_time(run_cached, make_args, ks=ks, reps=reps)
+        hit, peak = _sim_cache_hit_rate(vocab, batch, cache_rows, fe)
+        out[f"flush_every_{fe}"] = {
+            "step_ms": round(sec * 1e3, 3),
+            "hit_rate": round(hit, 4),
+            "sim_peak_dir": peak,
+            "would_overflow": peak > cache_rows,
+            "vs_eager": round(eager_sec / max(sec, 1e-9), 3),  # >1 = cache wins
+        }
+    return out
+
+
 def bench_serving(batch_size: int = 8192, embed_dim: int = 64,
                   top_k: int = 100) -> dict:
     """Serving-path latency: the frontend's jitted scoring program at its
@@ -829,6 +972,9 @@ def main() -> None:
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving-path records (serve_score8 / "
                          "serve_retrieve8)")
+    ap.add_argument("--skip-cache", action="store_true",
+                    help="skip the update-cache amortization record "
+                         "(cache_zipf)")
     ap.add_argument("--hot-vocab", type=int, default=0,
                     help="dlrm-criteo only: split every table's [0, K) "
                          "frequency-ranked prefix into a replicated hot head "
@@ -917,6 +1063,13 @@ def main() -> None:
         except Exception as e:  # serving records must never kill the headline
             print(f"bench: serving bench failed: {e!r}", file=sys.stderr)
 
+    cache_zipf = {}
+    if on_tpu and not args.skip_cache and not args.dense:
+        try:
+            cache_zipf = bench_cache_zipf()
+        except Exception as e:  # cache record must never kill the headline
+            print(f"bench: cache bench failed: {e!r}", file=sys.stderr)
+
     repo = Path(__file__).parent
     baseline_path = repo / "BENCH_BASELINE.json"
     model_name = "twotower" if args.dense else args.model
@@ -951,6 +1104,7 @@ def main() -> None:
         "embedding_lookup_p50_us": lookup,
         "big_table_demo": big_table,
         "serving": serving,
+        "cache_zipf": cache_zipf,
         "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,

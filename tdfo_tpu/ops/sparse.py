@@ -43,6 +43,9 @@ __all__ = [
     "dense_lazy_adagrad",
     "dense_lazy_rowwise_adagrad",
     "fat_update",
+    "cache_route",
+    "cache_lookup_rows",
+    "cache_overlay_rows",
     "SparseOptimizer",
     "sparse_optimizer",
 ]
@@ -373,6 +376,158 @@ def dense_lazy_rowwise_adagrad(table, accum, ids, grads, *, lr, eps=1e-10,
                            component_key(sr_key, 0)), table),
         jnp.where(touched[:, 0], acc_n, accum),
     )
+
+
+# --- device-resident update cache (software MANAGED_CACHING) ---------------
+#
+# fbgemm's cached TBE (``EmbeddingLocation.MANAGED_CACHING`` + ``lxu_cache``)
+# rebuilt for a chip whose scatter costs ~60-110 ns/slot regardless of hints
+# (docs/BUDGET.md): the step's touched rows live in a small dense cache —
+# sorted-id directory, [C, d] value array, optimizer-slot mirrors, dirty mask,
+# frequency/recency counters — all plain arrays carried in the train state.
+# Misses are ADMITTED (a gather-only copy of the authoritative big-table row),
+# hits and fresh admissions update IN the cache with the exact per-row
+# ``sparse_*`` math, and dirty rows write back to the big table verbatim in
+# ONE coalesced scatter at flush time.  Because the cached row is the
+# authoritative value and flush copies bits, any (train -> flush) prefix
+# reproduces the eager tables bit-for-bit; the per-slot scatter cost is paid
+# once per flush interval instead of once per step.
+#
+# The directory is two [C] arrays: ``ids`` sorted ascending (int32-max
+# sentinels = free entries, grouped at the top by the sort) and ``slot``, the
+# physical row each directory entry owns (a permutation of [0, C) — value
+# rows never move, only the id/slot pairs re-sort on admission/eviction).
+# Membership is one ``searchsorted(method="sort")`` per step (~0.14 ms at 8k
+# on v5e), branch-free.
+
+_CACHE_OOB = 2**31 - 1  # int32 max: free-directory-entry / invalid sentinel
+
+
+def cache_route(cache, ids):
+    """Route ``ids`` (any shape, array-row space, negatives = padding)
+    through the cache directory.  Returns ``(phys, hit)``: the physical
+    cache row per id (``C`` — one past the end, gather-clamp/scatter-drop —
+    where ``hit`` is False)."""
+    cids = cache["ids"]
+    c = cids.shape[0]
+    pos = jnp.searchsorted(cids, ids, method="sort").astype(jnp.int32)
+    posc = jnp.minimum(pos, c - 1)
+    hit = (cids[posc] == ids) & (ids >= 0) & (ids < _CACHE_OOB)
+    phys = jnp.where(hit, cache["slot"][posc], c)
+    return phys, hit
+
+
+def _replicated_shard_map(f, mesh):
+    """Run ``f`` in manual-SPMD mode with every operand fully replicated.
+
+    The cache's directory math (searchsorted routing, admission sorts, [C]
+    scatters) is replicated state by contract, but under GSPMD the sharding
+    PROPAGATION — not the committed input shardings, and not even explicit
+    boundary ``with_sharding_constraint`` pins — decides the layout of every
+    interior op, and it is free to partition the sort/scatter chain over the
+    batch axis.  Observed: inside the fused train-step program the cache
+    update's scatters are silently DROPPED when that happens (admission
+    survives, ``dirty``/``freq``/row writes vanish).  A fully-replicated
+    ``shard_map`` takes the partitioner out of the loop: every device runs
+    the identical cache-sized computation on full copies."""
+    from tdfo_tpu.core.mesh import shard_map
+
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
+                     check_vma=False)
+
+
+def cache_lookup_rows(cache, ids, *, mesh=None):
+    """Route ``ids`` and gather their cached rows: ``(rows[..., d],
+    hit[...])``.  Pass the device ``mesh`` from inside multi-device jitted
+    programs so the route runs replicated (see
+    :func:`_replicated_shard_map`); the gathered rows come back replicated
+    and mix freely with sharded activations."""
+    def f(cids, cslot, crows, q):
+        phys, hit = cache_route({"ids": cids, "slot": cslot}, q)
+        cur = jnp.take(crows, jnp.minimum(phys, crows.shape[0] - 1), axis=0)
+        return cur, hit
+    if mesh is not None:
+        f = _replicated_shard_map(f, mesh)
+    return f(cache["ids"], cache["slot"], cache["rows"], ids)
+
+
+def cache_overlay_rows(cache, ids, rows, *, mesh=None):
+    """Serve cached rows into a gathered block: where ``ids`` hit the
+    directory, replace ``rows`` (``[..., d]``, gathered from the possibly
+    stale big table) with the authoritative cache value.  Gather-only —
+    this is what keeps the forward bit-identical to the eager path between
+    flushes."""
+    cur, hit = cache_lookup_rows(cache, ids, mesh=mesh)
+    return jnp.where(hit[..., None], cur.astype(rows.dtype), rows)
+
+
+def _cache_mirror_keys(kind):
+    """Optimizer-slot mirror keys carried per cached row."""
+    return {"sgd": (), "adagrad": ("acc",), "rowwise_adagrad": ("acc",),
+            "adam": ("mu", "nu")}[kind]
+
+
+def _cache_slot_mirror(key, kind, c, d, slot_dtype):
+    """Empty [C]-leading mirror of the big-table slot component ``key``."""
+    if kind == "rowwise_adagrad":
+        # ONE f32 accumulator per row (the fbgemm parity contract)
+        return jnp.zeros((c,), jnp.float32)
+    return jnp.zeros((c, d), jnp.dtype(slot_dtype))
+
+
+def _cache_gather_slot(key, slots, kind, src):
+    big = {"acc": 0, "mu": 0, "nu": 1}[key] if kind != "rowwise_adagrad" else 0
+    return jnp.take(slots[big], src, axis=0)
+
+
+def _cache_admit(cache, urows, uslot, uids, valid, kind, step):
+    """Admit every missing valid ``uid``: assign free physical slots, copy
+    the authoritative rows + slot mirrors from the PRE-GATHERED per-uid
+    blocks (``urows[U, d]`` / ``uslot`` — the big arrays never enter: their
+    gathers happen outside, where GSPMD partitions plain gathers
+    correctly), and re-sort the directory.  Distinct ids past the free
+    capacity are counted into the ``over`` counter — their updates would be
+    silently lost, so callers must treat a non-zero counter as a hard
+    error."""
+    c = cache["ids"].shape[0]
+    cids, cslot = cache["ids"], cache["slot"]
+    _, hit = cache_route(cache, uids)
+    miss = valid & ~hit
+    oob = jnp.asarray(_CACHE_OOB, jnp.int32)
+    # pair-sort carries each missing id's position in ``uids`` along, so
+    # the pre-gathered row/mirror blocks index by ``upos`` (order-free: no
+    # sortedness assumption on ``uids``)
+    smid, upos = jax.lax.sort(
+        (jnp.where(miss, uids, oob),
+         jnp.arange(uids.shape[0], dtype=jnp.int32)),
+        num_keys=1, is_stable=False)
+    n_miss = jnp.sum(miss).astype(jnp.int32)
+    n_used = jnp.sum(cids < oob).astype(jnp.int32)
+    k = jnp.arange(smid.shape[0], dtype=jnp.int32)
+    dirpos = n_used + k
+    admit = (k < n_miss) & (dirpos < c)
+    over = jnp.sum((k < n_miss) & (dirpos >= c)).astype(jnp.int32)
+    # the k-th new id takes the k-th free directory entry (free entries are
+    # the sentinel-id tail of the sorted directory) and inherits its
+    # physical slot; one pair-sort restores directory order
+    phys = cslot[jnp.minimum(dirpos, c - 1)]
+    new_ids = cids.at[jnp.where(admit, dirpos, c)].set(smid, mode="drop")
+    sids, sslot = jax.lax.sort((new_ids, cslot), num_keys=1, is_stable=False)
+    tgt = jnp.where(admit, phys, c)
+    cache = dict(cache)
+    cache["ids"], cache["slot"] = sids, sslot
+    cache["rows"] = cache["rows"].at[tgt].set(
+        jnp.take(urows, upos, axis=0), mode="drop")
+    for key in _cache_mirror_keys(kind):
+        cache[key] = cache[key].at[tgt].set(
+            jnp.take(uslot[key], upos, axis=0), mode="drop")
+    cache["dirty"] = cache["dirty"].at[tgt].set(False, mode="drop")
+    cache["freq"] = cache["freq"].at[tgt].set(0, mode="drop")
+    cache["last"] = cache["last"].at[tgt].set(step, mode="drop")
+    cache["over"] = cache["over"] + over
+    return cache
 
 
 def _lines_from_unique(uids, g, valid, layout):
@@ -872,6 +1027,187 @@ class SparseOptimizer:
         raise ValueError(
             f"dense_update needs a plain 2D table (kind {self.kind!r}, "
             f"ndim {table.ndim})")
+
+    def cache_init(self, table, cache_rows: int):
+        """Empty update-cache pytree for a plain 2D ``table``: sorted-id
+        directory (+ its physical-slot permutation), value rows at the
+        table's storage dtype, per-kind optimizer-slot mirrors, dirty mask,
+        frequency/recency counters, and the admission-overflow counter."""
+        if table.ndim != 2:
+            raise ValueError(
+                "the update cache covers plain 2D tables only (fat-line "
+                "arrays keep their in-place DMA path)")
+        c = int(cache_rows)
+        d = table.shape[1]
+        cache = {
+            "ids": jnp.full((c,), _CACHE_OOB, jnp.int32),
+            "slot": jnp.arange(c, dtype=jnp.int32),
+            "rows": jnp.zeros((c, d), table.dtype),
+            "dirty": jnp.zeros((c,), bool),
+            "freq": jnp.zeros((c,), jnp.int32),
+            "last": jnp.zeros((c,), jnp.int32),
+            "over": jnp.zeros((), jnp.int32),
+        }
+        for key in _cache_mirror_keys(self.kind):
+            cache[key] = _cache_slot_mirror(key, self.kind, c, d,
+                                            self.slot_dtype)
+        return cache
+
+    def cache_update_unique(self, cache, table, slots, uids, g, valid, *,
+                            step, sr_key=None, mesh=None):
+        """Cached step on PRE-deduplicated ``(uids, g, valid)``: admit
+        misses (gather-only), then apply the EXACT per-row ``sparse_*``
+        math to the cached rows/mirrors and scatter into the [C] cache —
+        the big table and its slot row arrays are read, never written.
+        ``step`` feeds the recency counter.  Returns ``(cache, slots)``
+        (``slots`` changes only for adam's global step count).  Pass the
+        device ``mesh`` when calling from inside a multi-device jitted
+        program: the cache math then runs in a fully-replicated
+        ``shard_map`` (see :func:`_replicated_shard_map`) while the big
+        table/slot gathers stay outside on the sharded arrays."""
+        # the ONLY touches of the big arrays: plain per-uid row gathers,
+        # which GSPMD partitions correctly on sharded tables
+        gid = jnp.minimum(jnp.where(valid, uids, 0), table.shape[0] - 1)
+        urows = jnp.take(table, gid, axis=0)
+        uslot = {key: _cache_gather_slot(key, slots, self.kind, gid)
+                 for key in _cache_mirror_keys(self.kind)}
+        count = slots[2] if self.kind == "adam" else None
+        math = self._cache_math
+        if mesh is not None:
+            math = _replicated_shard_map(math, mesh)
+        cache, new_count = math(cache, uids, g, valid, urows, uslot, step,
+                                count, sr_key)
+        if self.kind == "adam":
+            return cache, (slots[0], slots[1], new_count)
+        return cache, slots
+
+    def _cache_math(self, cache, uids, g, valid, urows, uslot, step, count,
+                    sr_key):
+        """Admission + per-kind cached update on cache-sized operands only
+        (big-table rows and slot mirrors arrive pre-gathered as
+        ``urows``/``uslot``) — the body ``cache_update_unique`` optionally
+        wraps in a replicated shard_map."""
+        cache = _cache_admit(cache, urows, uslot, uids, valid, self.kind,
+                             step)
+        c = cache["ids"].shape[0]
+        cs, _ = cache_route(cache, uids)
+        csc = jnp.minimum(cs, c - 1)
+        cur = jnp.take(cache["rows"], csc, axis=0).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        lr, wd, eps = self.lr, self.weight_decay, self.eps
+        new_count = count
+        cache = dict(cache)
+        if self.kind == "sgd":
+            g2 = g + wd * cur
+            cache["rows"] = cache["rows"].at[cs].set(
+                quantize(cur - lr * g2, cache["rows"].dtype, sr_key),
+                mode="drop")
+        elif self.kind == "adagrad":
+            acc_r = jnp.take(cache["acc"], csc, axis=0).astype(jnp.float32)
+            g2 = g + wd * cur
+            acc_n = acc_r + g2 * g2
+            delta = lr * g2 / (jnp.sqrt(acc_n) + eps)
+            cache["rows"] = cache["rows"].at[cs].set(
+                quantize(cur - delta, cache["rows"].dtype,
+                         component_key(sr_key, 0)), mode="drop")
+            cache["acc"] = cache["acc"].at[cs].set(
+                quantize(acc_n, cache["acc"].dtype,
+                         component_key(sr_key, 1)), mode="drop")
+        elif self.kind == "rowwise_adagrad":
+            acc_r = jnp.take(cache["acc"], csc)  # [U] — always f32
+            g2 = g + wd * cur
+            acc_n = acc_r + jnp.mean(g2 * g2, axis=-1)
+            delta = lr * g2 / (jnp.sqrt(acc_n)[:, None] + eps)
+            cache["rows"] = cache["rows"].at[cs].set(
+                quantize(cur - delta, cache["rows"].dtype,
+                         component_key(sr_key, 0)), mode="drop")
+            cache["acc"] = cache["acc"].at[cs].set(acc_n, mode="drop")
+        elif self.kind == "adam":
+            mu_r = jnp.take(cache["mu"], csc, axis=0).astype(jnp.float32)
+            nu_r = jnp.take(cache["nu"], csc, axis=0).astype(jnp.float32)
+            new_count = count + 1
+            t = new_count.astype(jnp.float32)
+            mu_n = self.b1 * mu_r + (1 - self.b1) * g
+            nu_n = self.b2 * nu_r + (1 - self.b2) * g * g
+            mu_hat = mu_n / (1 - self.b1**t)
+            nu_hat = nu_n / (1 - self.b2**t)
+            delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + wd * cur)
+            cache["rows"] = cache["rows"].at[cs].set(
+                quantize(cur - delta, cache["rows"].dtype,
+                         component_key(sr_key, 0)), mode="drop")
+            cache["mu"] = cache["mu"].at[cs].set(
+                quantize(mu_n, cache["mu"].dtype, component_key(sr_key, 1)),
+                mode="drop")
+            cache["nu"] = cache["nu"].at[cs].set(
+                quantize(nu_n, cache["nu"].dtype, component_key(sr_key, 2)),
+                mode="drop")
+        else:
+            raise ValueError(self.kind)
+        cache["dirty"] = cache["dirty"].at[cs].set(True, mode="drop")
+        cache["freq"] = cache["freq"].at[cs].add(1, mode="drop")
+        cache["last"] = cache["last"].at[cs].set(step, mode="drop")
+        return cache, new_count
+
+    def cache_update(self, cache, table, slots, ids, grads, *, step,
+                     capacity: int | None = None,
+                     max_distinct: int | None = None, sr_key=None,
+                     mesh=None):
+        """Cached analogue of :meth:`update` for plain 2D tables: the SAME
+        ``dedupe_grads`` call (bit-identical summed grads), then
+        :meth:`cache_update_unique`.  Returns ``(cache, slots)``."""
+        uids, g, valid = dedupe_grads(
+            ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
+            capacity=capacity, vocab=table.shape[0],
+            max_distinct=max_distinct)
+        return self.cache_update_unique(cache, table, slots, uids, g, valid,
+                                        step=step, sr_key=sr_key, mesh=mesh)
+
+    def cache_flush(self, cache, table, slots):
+        """Write every dirty cached row (+ slot mirrors) back to the big
+        table in ONE coalesced scatter — a verbatim bit-copy, so the
+        flushed table equals the eager-path table exactly — then evict down
+        to the hottest ``C // 2`` entries by (frequency, recency, id) and
+        age the retained frequency counters.  Returns ``(cache, table,
+        slots, overflow)`` where ``overflow`` is the interval's admission
+        overflow count (MUST be zero; updates past capacity were lost)."""
+        c = cache["ids"].shape[0]
+        cids, cslot = cache["ids"], cache["slot"]
+        oob = jnp.asarray(_CACHE_OOB, jnp.int32)
+        dirty_dir = jnp.take(cache["dirty"], cslot) & (cids < oob)
+        tgt = jnp.where(dirty_dir, cids, table.shape[0])
+        table = table.at[tgt].set(
+            jnp.take(cache["rows"], cslot, axis=0), mode="drop")
+        new_slots = list(slots)
+        for key in _cache_mirror_keys(self.kind):
+            big = ({"acc": 0, "mu": 0, "nu": 1}[key]
+                   if self.kind != "rowwise_adagrad" else 0)
+            new_slots[big] = new_slots[big].at[tgt].set(
+                jnp.take(cache[key], cslot, axis=0), mode="drop")
+        # retention: hottest-first rank by (freq desc, recency desc, id) —
+        # deterministic; evicted entries are clean post-writeback so
+        # eviction just frees their directory entry + physical slot
+        keep_k = c // 2
+        used = cids < oob
+        imax = jnp.asarray(jnp.iinfo(jnp.int32).max, jnp.int32)
+        nf = jnp.where(used, -jnp.take(cache["freq"], cslot), imax)
+        nl = jnp.where(used, -jnp.take(cache["last"], cslot), imax)
+        _, _, s_ids, s_slot = jax.lax.sort((nf, nl, cids, cslot),
+                                           num_keys=3, is_stable=False)
+        keep = jnp.arange(c, dtype=jnp.int32) < keep_k
+        new_ids, new_slot = jax.lax.sort(
+            (jnp.where(keep, s_ids, oob), s_slot), num_keys=1,
+            is_stable=False)
+        retained = jnp.zeros((c,), bool).at[
+            jnp.where(keep & (s_ids < oob), s_slot, c)].set(
+                True, mode="drop")
+        cache = dict(cache)
+        cache["ids"], cache["slot"] = new_ids, new_slot
+        cache["dirty"] = jnp.zeros_like(cache["dirty"])
+        cache["freq"] = jnp.where(retained, cache["freq"] // 2, 0)
+        cache["last"] = jnp.where(retained, cache["last"], 0)
+        over = cache["over"]
+        cache["over"] = jnp.zeros((), jnp.int32)
+        return cache, table, tuple(new_slots), over
 
     def update(self, table, slots, ids, grads, *, embedding_dim: int | None = None,
                capacity: int | None = None, max_distinct: int | None = None,

@@ -162,6 +162,13 @@ def _a2a_bucket_cap(n: int, m: int, cf: float | None) -> int:
     return cap
 
 
+# state.slots key prefix of a cached array's update-cache pytree
+# (``SparseOptimizer.cache_init``).  Riding inside the existing slots dict
+# keeps the train-state STRUCTURE unchanged when the cache is off, so
+# legacy checkpoints restore and the default graphs stay byte-identical.
+CACHE_PREFIX = "__cache__/"
+
+
 class ShardedEmbeddingCollection:
     """A set of embedding tables with mesh shardings + lookup programs.
 
@@ -181,6 +188,7 @@ class ShardedEmbeddingCollection:
         fused_kind: str = "adam",
         hot_ids: Mapping[str, np.ndarray] | None = None,
         grouped_a2a: bool = False,
+        cache_rows: int = 0,
     ):
         """``a2a_capacity_factor``: per-shard send-bucket capacity for the
         alltoall lookup program, as a multiple of the balanced share
@@ -234,7 +242,17 @@ class ShardedEmbeddingCollection:
         program; update numerics are bit-identical when each table serves a
         single feature (every shipped schema) — tables shared by several
         features receive the same per-row grad addends in a different
-        (shard-major instead of feature-major) summation order."""
+        (shard-major instead of feature-major) summation order.
+
+        ``cache_rows``: device-resident update cache (software
+        ``MANAGED_CACHING``, fbgemm lxu-cache analogue) — every plain 2D
+        big-table array carries a ``cache_rows``-row cache in the train
+        state (:meth:`init_caches`): touched rows are admitted on miss
+        (gather-only), updated scatter-free IN the cache
+        (``SparseOptimizer.cache_update``), and written back to the big
+        table in one coalesced scatter per flush interval.  Training stays
+        bit-identical to the eager path; 0 disables (and compiles the
+        existing byte-identical graphs)."""
         from tdfo_tpu.ops.pallas_kernels import line_layout
 
         self.fused_kind = fused_kind
@@ -250,6 +268,9 @@ class ShardedEmbeddingCollection:
             a2a_capacity_factor = None
         self.a2a_capacity_factor = a2a_capacity_factor
         self.grouped_a2a = grouped_a2a
+        if cache_rows < 0:
+            raise ValueError("cache_rows must be >= 0")
+        self.cache_rows = cache_rows
         self._grouped_plans: dict[tuple[str, ...], tuple[_A2AGroup, ...]] = {}
         self.n_shards = mesh.shape[axis] if mesh is not None else 1
         self._feature_to_table: dict[str, str] = {}
@@ -570,6 +591,49 @@ class ShardedEmbeddingCollection:
                 hot = jax.device_put(hot, NamedSharding(self.mesh, P()))
             tables[self.hot_array_name(tname)] = hot
         return tables
+
+    # -------------------------------------------------------- update cache
+
+    def cached_array_names(self, opt, tables) -> tuple[str, ...]:
+        """Array names the update cache covers (sorted): plain 2D arrays
+        that actually receive row-sparse updates.  Excluded: fat 3D arrays
+        (their in-place DMA kernel is already the scatter answer), hot
+        HEADS and full-hot cold arrays (dense/never updated), and
+        small-vocab adam arrays (``dense_lazy_adam`` is already
+        scatter-free)."""
+        if self.cache_rows <= 0:
+            return ()
+        hot_heads = {self.hot_array_name(t) for t in self.hot_ids}
+        updated = set()
+        for tname in self.specs:
+            if self._hot_full.get(tname, False):
+                continue  # cold side is dead storage, never updated
+            aname, _, _ = self.resolve_table(tname)
+            updated.add(aname)
+        out = []
+        for aname in sorted(updated):
+            t = tables[aname]
+            if t.ndim != 2 or aname in hot_heads:
+                continue
+            if opt.kind == "adam" and t.shape[0] <= opt.small_vocab_threshold:
+                continue
+            out.append(aname)
+        return tuple(out)
+
+    def init_caches(self, tables, opt) -> dict[str, dict]:
+        """Fresh (empty) update caches for every cached array, keyed
+        ``CACHE_PREFIX + array_name`` — merged into ``state.slots`` by the
+        trainer so checkpoint/rollback/donation cover the cache for free.
+        Caches are replicated (P()): C is small and every device routes the
+        full id stream through the directory."""
+        out: dict[str, dict] = {}
+        for aname in self.cached_array_names(opt, tables):
+            cache = opt.cache_init(tables[aname], self.cache_rows)
+            if self.mesh is not None:
+                cache = jax.device_put(
+                    cache, NamedSharding(self.mesh, P()))
+            out[CACHE_PREFIX + aname] = cache
+        return out
 
     # -------------------------------------------------------------- lookup
 

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tdfo_tpu.core.precision import compute_dtype
-from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+from tdfo_tpu.parallel.embedding import CACHE_PREFIX, ShardedEmbeddingCollection
 
 __all__ = [
     "BUNDLE_VERSION",
@@ -56,7 +56,9 @@ _ARRAYS = "arrays.npz"
 
 
 def merged_tables(
-    coll: ShardedEmbeddingCollection, tables: Mapping[str, jax.Array]
+    coll: ShardedEmbeddingCollection,
+    tables: Mapping[str, jax.Array],
+    caches: Mapping[str, Any] | None = None,
 ) -> dict[str, np.ndarray]:
     """Live ``init()`` pytree -> logical ``{table_name: [V, d] f32}`` rows.
 
@@ -66,6 +68,14 @@ def merged_tables(
     rows written back over their dead cold duplicates).  Host-side numpy —
     export is offline, so the scatter-avoidance rules for jitted steps do
     not apply here.
+
+    ``caches``: the ``state.slots`` update-cache entries (keys prefixed
+    ``CACHE_PREFIX``) of a cache-enabled run whose state was NOT flushed
+    first — dirty cached rows overlay their stale big-table values
+    verbatim, so bundles from cached and eager runs of the same trajectory
+    stay bitwise-identical.  Flushed (or cache-off) states need no
+    ``caches``; the trainer flushes before every checkpoint so exports
+    from checkpoints never do.
     """
     from tdfo_tpu.ops.pallas_kernels import fat_view
 
@@ -78,7 +88,19 @@ def merged_tables(
             if arr.ndim == 3:  # fused fat lines [L, T, 128]
                 lay = coll.fat_layout(coll.array_embedding_dim(aname))
                 arr = np.asarray(fat_view(jnp.asarray(arr), lay))
-            views[aname] = np.asarray(arr)
+            arr = np.asarray(arr)
+            cache = (caches or {}).get(CACHE_PREFIX + aname)
+            if cache is not None:
+                # write dirty cached rows back over their stale big-table
+                # values (bit-copy, the host twin of cache_flush)
+                c = jax.device_get(cache)
+                ids = np.asarray(c["ids"])
+                slot = np.asarray(c["slot"])
+                dirty = np.asarray(c["dirty"])[slot] & (ids < 2**31 - 1)
+                if dirty.any():
+                    arr = arr.copy()
+                    arr[ids[dirty]] = np.asarray(c["rows"])[slot[dirty]]
+            views[aname] = arr
         d = spec.embedding_dim
         rows = np.array(
             views[aname][off:off + spec.num_embeddings, :d], dtype=np.float32
@@ -170,6 +192,7 @@ def export_bundle(
     tables: Mapping[str, jax.Array] | None = None,
     dense_params: Mapping[str, Any] | None = None,
     params: Mapping[str, Any] | None = None,
+    caches: Mapping[str, Any] | None = None,
     mixed_precision: bool = False,
     platform: str | None = None,
 ) -> Path:
@@ -178,7 +201,9 @@ def export_bundle(
     Sparse/DMP regime: pass ``coll`` + ``tables`` + ``dense_params`` (the
     ``SparseTrainState`` pieces); tables are merged via :func:`merged_tables`.
     Dense regime (replicated TwoTower): pass ``params`` (the full flax tree).
-    ``mixed_precision=True`` applies the platform cast policy
+    ``caches``: forwarded to :func:`merged_tables` — REQUIRED when exporting
+    an UNFLUSHED cache-enabled live state (checkpointed states are always
+    flushed).  ``mixed_precision=True`` applies the platform cast policy
     (:func:`compute_dtype`: bf16 on TPU) to every floating array; the default
     keeps f32 so serving logits stay bitwise equal to training eval logits.
     """
@@ -206,7 +231,7 @@ def export_bundle(
     if coll is not None:
         if tables is None or dense_params is None:
             raise ValueError("sparse export needs tables and dense_params")
-        logical = merged_tables(coll, tables)
+        logical = merged_tables(coll, tables, caches)
         manifest["tables"] = {
             n: [int(t.shape[0]), int(t.shape[1])] for n, t in logical.items()
         }

@@ -26,8 +26,9 @@ import jax.numpy as jnp
 import optax
 
 from tdfo_tpu.ops.quant import sr_key as _make_sr_key
-from tdfo_tpu.ops.sparse import SparseOptimizer, dedupe_ids
-from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+from tdfo_tpu.ops.sparse import SparseOptimizer, cache_lookup_rows, dedupe_ids
+from tdfo_tpu.ops.sparse import cache_overlay_rows
+from tdfo_tpu.parallel.embedding import CACHE_PREFIX, ShardedEmbeddingCollection
 
 
 def _array_is_narrow(state: "SparseTrainState", aname: str) -> bool:
@@ -40,9 +41,31 @@ def _array_is_narrow(state: "SparseTrainState", aname: str) -> bool:
     return any(leaf.dtype == jnp.bfloat16
                for leaf in jax.tree_util.tree_leaves(state.slots[aname]))
 
+
+def _pin_replicated(mesh, tree):
+    """Constrain every leaf of ``tree`` to a fully-replicated layout.
+
+    The update cache is replicated state by contract (``init_caches``
+    commits it at ``P()``), but inside a jitted program GSPMD's sharding
+    PROPAGATION — not the committed input shardings — decides the layout
+    of intermediates, and it is free to partition the [C] sorted-id
+    directory over the batch axis (observed under the trainer's fused
+    step+AUC program: a data-sharded directory breaks the searchsorted
+    routing and silently drops every cache write).  Explicit constraints
+    at the cache read and write boundaries make replication part of the
+    program instead of a propagation accident.  No-op when ``mesh`` is
+    None (single-device / eager tests)."""
+    if mesh is None:
+        return tree
+    s = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, s), tree)
+
+
 __all__ = [
     "SparseTrainState",
     "make_sparse_train_step",
+    "make_cache_flush_fn",
     "PipelinedSparseStep",
     "make_pipelined_sparse_train_step",
 ]
@@ -134,11 +157,27 @@ def make_sparse_train_step(
     cold half rides the unchanged machinery above with hot hits as -1
     (dropped by dedupe like padding).  Fully-hot tables skip the cold side
     statically, shrinking the cold distinct-row bound and scatter cost.
+
+    Update cache (collection built with ``cache_rows > 0`` AND a state
+    whose ``slots`` carry the ``coll.init_caches`` entries, requires
+    ``mode="gspmd"``): every cached array's row update runs IN its cache
+    (``SparseOptimizer.cache_update[_unique]`` — admit misses gather-only,
+    update hits scatter-free, touch no big array), and forward gathers
+    overlay the cached rows so nothing ever reads a stale big-table value.
+    The step's jaxpr then contains NO scatter into any big table; the
+    trainer pays the coalesced write-back via :func:`make_cache_flush_fn`
+    once per ``flush_every`` interval.  Bit-identical to the eager path
+    (see ``ops/sparse.py``'s cache section for why).  A state without
+    cache entries — the default — traces the exact pre-cache graph.
     """
     import inspect
 
     if dedup_lookup and mode != "gspmd":
         raise ValueError("dedup_lookup composes with lookup mode 'gspmd' only")
+    if coll.cache_rows > 0 and mode != "gspmd":
+        raise ValueError(
+            "the update cache (cache_rows > 0) composes with lookup mode "
+            "'gspmd' only")
     features = list(coll.features())
     takes_rng = "dropout_rng" in inspect.signature(forward).parameters
     # hot/cold (frequency-partitioned) tables: per-feature id routing splits
@@ -207,6 +246,12 @@ def make_sparse_train_step(
         if batch_transform is not None:
             batch = batch_transform(batch)
         ids = {f: batch[f] for f in features}
+        # update-cache coverage, static under jit: the presence of the
+        # coll.init_caches entries in state.slots IS the enable signal, so
+        # a cache-off state traces the exact pre-cache (byte-identical)
+        # graph even on a cache_rows > 0 collection
+        cached = {k[len(CACHE_PREFIX):] for k in state.slots
+                  if k.startswith(CACHE_PREFIX)}
         step_rng = None
         if takes_rng and rng is not None:
             step_rng = jax.random.fold_in(rng, state.step)
@@ -236,6 +281,38 @@ def make_sparse_train_step(
                 return hot_vec
             return jnp.where((hp >= 0)[..., None], hot_vec, cold_vec)
 
+        def _overlay_lookup(embs, feats):
+            """Serve cached rows into ``coll.lookup`` outputs: between
+            flushes the big tables are stale for dirty cached rows, so any
+            position whose gather landed on a cached row must show the
+            cache value — replicating each lookup path's own padding-clamp
+            semantics so the overlaid vector equals the eager-path gather
+            bit-for-bit."""
+            for f in feats:
+                aname, _, off = coll.resolve(f)
+                # fully hot features never read their (dead) cold rows
+                if aname not in cached or f in full_hot_feats:
+                    continue
+                cache = _pin_replicated(
+                    coll.mesh, state.slots[CACHE_PREFIX + aname])
+                hp = hot_pos.get(f)
+                if hp is None:
+                    # plain gspmd lookup: jnp.take clamps out-of-range ids
+                    v = state.tables[aname].shape[0]
+                    gid = jnp.clip(ids[f] + off, 0, v - 1)
+                else:
+                    # hot/cold lookup gathers cold at where(cold >= 0,
+                    # cold + off, 0) and selects the hot head at hot hits —
+                    # those positions must keep the (authoritative) hot vec
+                    cold = cold_ids[f]
+                    gid = jnp.where(cold >= 0, cold + off, 0)
+                cur, hit = cache_lookup_rows(cache, gid, mesh=coll.mesh)
+                if hp is not None:
+                    hit = hit & (hp < 0)
+                embs[f] = jnp.where(
+                    hit[..., None], cur.astype(embs[f].dtype), embs[f])
+            return embs
+
         # Gradients w.r.t. the gathered vectors, never the [V, D] table.
         def loss_from_embs(dense_params, embs):
             if takes_rng:
@@ -252,8 +329,9 @@ def make_sparse_train_step(
                 # update falls back too, since no ctx entry exists)
                 if (tname in coll.specs
                         and coll.specs[tname].sharding == "column"):
-                    embs.update(coll.lookup(
-                        state.tables, {f: ids[f] for f in feats}, mode=mode))
+                    embs.update(_overlay_lookup(coll.lookup(
+                        state.tables, {f: ids[f] for f in feats}, mode=mode),
+                        feats))
                     continue
                 table = state.tables[tname]
                 d = coll.array_embedding_dim(tname)
@@ -303,6 +381,17 @@ def make_sparse_train_step(
                         max_distinct=cap,
                     )
                     rows = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
+                    if tname in cached:
+                        # serve cached (authoritative) rows into the compact
+                        # gather — sentinel slots clamp to row 0 exactly like
+                        # the eager gather, so they overlay to row 0's
+                        # authoritative value too
+                        rows = cache_overlay_rows(
+                            _pin_replicated(
+                                coll.mesh,
+                                state.slots[CACHE_PREFIX + tname]),
+                            jnp.where(valid, uids, 0),
+                            rows, mesh=coll.mesh)
                     dedup_ctx[tname] = ("rows", uids, seg, valid)
                 off = 0
                 # dequantize after the compact gather (identity for f32):
@@ -317,7 +406,8 @@ def make_sparse_train_step(
                 embs[f] = _merge_hot(f, None)
         else:
             # coll.lookup routes hot/cold internally (eval shares that path)
-            embs = coll.lookup(state.tables, ids, mode=mode)
+            embs = _overlay_lookup(
+                coll.lookup(state.tables, ids, mode=mode), features)
         loss, (g_dense, g_embs) = jax.value_and_grad(
             loss_from_embs, argnums=(0, 1), has_aux=with_aux
         )(state.dense_params, embs)
@@ -394,6 +484,24 @@ def make_sparse_train_step(
                     all_grads, seg, num_segments=uids.shape[0]
                 )
                 g_u = jnp.where(valid[:, None], g_u, 0.0)
+                if tname in cached:
+                    # cached tier: admit misses (gather-only), update in
+                    # the cache — the big table and slot rows stay
+                    # untouched until the coalesced flush.  All cache-math
+                    # operands pin replicated (see _pin_replicated).
+                    ck = CACHE_PREFIX + tname
+                    u_r, g_r, v_r = _pin_replicated(
+                        coll.mesh, (uids, g_u, valid))
+                    new_cache, new_slots[tname] = (
+                        state.sparse_opt.cache_update_unique(
+                            _pin_replicated(coll.mesh, state.slots[ck]),
+                            state.tables[tname],
+                            state.slots[tname], u_r, g_r, v_r,
+                            step=state.step, sr_key=_sr_key(tname),
+                            mesh=coll.mesh,
+                        ))
+                    new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
+                    continue
                 new_tables[tname], new_slots[tname] = state.sparse_opt.update_unique(
                     state.tables[tname], state.slots[tname], uids, g_u, valid,
                     embedding_dim=d_t, sr_key=_sr_key(tname),
@@ -406,6 +514,23 @@ def make_sparse_train_step(
             # every step) save ~half the update cost
             total = all_ids.shape[0]
             md = -(-bound // 8) * 8 if bound < total else None
+            if tname in cached and not small_adam:
+                # cached tier: the SAME dedupe (bit-identical summed grads)
+                # feeds the cache update; no big array is written.  All
+                # cache-math operands pin replicated (see _pin_replicated).
+                ck = CACHE_PREFIX + tname
+                i_r, g_r = _pin_replicated(
+                    coll.mesh, (all_ids, all_grads))
+                new_cache, new_slots[tname] = (
+                    state.sparse_opt.cache_update(
+                        _pin_replicated(coll.mesh, state.slots[ck]),
+                        state.tables[tname],
+                        state.slots[tname], i_r, g_r,
+                        step=state.step, capacity=md, max_distinct=md,
+                        sr_key=_sr_key(tname), mesh=coll.mesh,
+                    ))
+                new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
+                continue
             # sharding-aware routing: fused row-sharded tables update inside
             # an explicit shard_map (Pallas has no GSPMD partition rule)
             new_tables[tname], new_slots[tname] = coll.sparse_update(
@@ -448,6 +573,55 @@ def make_sparse_train_step(
     if not jit:
         return step
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_cache_flush_fn(*, donate: bool = True, jit: bool = True,
+                        mesh=None):
+    """Build the coalesced write-back program of the update cache:
+    ``flush(state) -> (state, overflow)``.
+
+    A SEPARATE jitted program from the train step — the trainer calls it
+    every ``flush_every`` steps and unconditionally before checkpoint,
+    eval, and serving export — so the big-table scatter cost is paid once
+    per interval and non-flush step jaxprs carry no big-table scatter at
+    all.  Per cached array it writes every dirty row + slot mirror back
+    verbatim (``SparseOptimizer.cache_flush``), evicts down to the hottest
+    half, and surfaces the interval's admission-overflow counters:
+    ``overflow`` maps array name -> int32 count of distinct ids whose
+    updates were LOST to a full cache.  Callers MUST fail on any non-zero
+    entry — the bit-exactness contract is broken past that point.  A state
+    without cache entries flushes to itself (empty overflow dict).  Pass
+    the collection's ``mesh`` so the cache stays pinned replicated inside
+    the jitted program (see ``_pin_replicated``)."""
+
+    def flush(state: SparseTrainState):
+        new_tables = dict(state.tables)
+        new_slots = dict(state.slots)
+        overflow = {}
+        for key in sorted(state.slots):
+            if not key.startswith(CACHE_PREFIX):
+                continue
+            aname = key[len(CACHE_PREFIX):]
+            cache, table, slots, over = state.sparse_opt.cache_flush(
+                _pin_replicated(mesh, state.slots[key]),
+                state.tables[aname], state.slots[aname])
+            new_tables[aname] = table
+            new_slots[aname] = slots
+            new_slots[key] = _pin_replicated(mesh, cache)
+            overflow[aname] = over
+        return SparseTrainState(
+            step=state.step,
+            dense_params=state.dense_params,
+            opt_state=state.opt_state,
+            tables=new_tables,
+            slots=new_slots,
+            tx=state.tx,
+            sparse_opt=state.sparse_opt,
+        ), overflow
+
+    if not jit:
+        return flush
+    return jax.jit(flush, donate_argnums=(0,) if donate else ())
 
 
 @dataclass(frozen=True)
@@ -510,6 +684,10 @@ def make_pipelined_sparse_train_step(
         raise ValueError(
             "hot/cold tables do not compose with the pipelined sparse step "
             "(they require lookup mode 'gspmd')")
+    if coll.cache_rows > 0:
+        raise ValueError(
+            "the update cache (cache_rows > 0) does not compose with the "
+            "pipelined sparse step (it requires lookup mode 'gspmd')")
     features = list(coll.features())
     takes_rng = "dropout_rng" in inspect.signature(forward).parameters
     grouped_feats = tuple(

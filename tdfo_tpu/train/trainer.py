@@ -296,6 +296,21 @@ def _copy_tree(tree):
         lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x, tree)
 
 
+def _check_cache_overflow(overflow: dict) -> None:
+    """Fail LOUDLY on update-cache admission overflow: ids past the free
+    capacity never entered the cache, so their updates were silently lost
+    and the bit-exactness contract is already broken — continuing would
+    train on corrupt tables."""
+    bad = {a: int(v) for a, v in overflow.items() if int(v) > 0}
+    if bad:
+        raise RuntimeError(
+            f"update-cache admission overflow (distinct ids whose updates "
+            f"were LOST): {bad}.  embeddings.cache_rows is too small for "
+            "the per-flush-interval working set — raise cache_rows (the "
+            "retained half must cover the interval's distinct touched "
+            "rows) or lower flush_every.")
+
+
 class Trainer:
     """Config-driven trainer for both workload families."""
 
@@ -315,6 +330,8 @@ class Trainer:
         self._logged_steps = 0  # run-global data-step counter (batches consumed)
         self._a2a_overflow = None  # alltoall dropped-id diagnostic (jitted)
         self._pipelined = False  # train.pipeline_overlap (prime/step/flush)
+        self._cache_flush = None  # update-cache write-back program (jitted)
+        self._flush_every = 0  # cache write-back cadence in train steps
         self._map_streams: dict = {}  # streaming=false table cache
         # retryable-I/O observability: failed attempts land next to
         # metrics.jsonl (process 0 only; set_failure_log is a no-op path-wise
@@ -504,6 +521,7 @@ class Trainer:
             fused_kind=cfg.sparse_optimizer,
             hot_ids=hot_ids,
             grouped_a2a=cfg.embeddings.grouped_a2a,
+            cache_rows=cfg.embeddings.cache_rows,
         )
         # hot/cold checkpoints are only loadable under the SAME hot sets —
         # stamp the digests into the checkpoint sidecar so a mismatched
@@ -520,6 +538,16 @@ class Trainer:
                 or cfg.embeddings.slot_dtype != "float32"):
             stamps["table_dtype"] = tstamp
             stamps["slot_dtype"] = cfg.embeddings.slot_dtype
+        if cfg.embeddings.cache_rows > 0:
+            # the cache arrays live in state.slots: a cached checkpoint
+            # cannot restore into a cache-off run (or vice versa, or across
+            # cache_rows), so stamp both knobs — flush_every too, so the
+            # restored run's flush cadence matches what the operator asked
+            # for rather than silently inheriting the sidecar-less default
+            stamps["update_cache"] = {
+                "cache_rows": int(cfg.embeddings.cache_rows),
+                "flush_every": int(cfg.embeddings.flush_every),
+            }
         self._ckpt_stamps = stamps or None
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)
@@ -551,6 +579,21 @@ class Trainer:
                 slot_dtype=cfg.embeddings.slot_dtype,
             ),
         ), self.mesh)
+        if cfg.embeddings.cache_rows > 0:
+            # device-resident update cache: empty caches ride state.slots
+            # (kill/resume, NaN-rollback snapshots and donation all cover
+            # them for free); the coalesced write-back runs as a SEPARATE
+            # jitted program every flush_every steps + before checkpoint/
+            # eval/export, so train-step jaxprs carry no big-table scatter
+            from tdfo_tpu.train.sparse_step import make_cache_flush_fn
+
+            caches = coll.init_caches(self.state.tables,
+                                      self.state.sparse_opt)
+            if caches:
+                self.state = dataclasses.replace(
+                    self.state, slots={**self.state.slots, **caches})
+                self._cache_flush = make_cache_flush_fn(mesh=coll.mesh)
+                self._flush_every = cfg.embeddings.flush_every
         if cfg.train.pipeline_overlap:
             # TrainPipelineSparseDist parity: batch N+1's input-dist issues
             # inside the jitted step ahead of batch N's fwd/bwd/update.  The
@@ -908,6 +951,13 @@ class Trainer:
         inj = _faults.active()
         t0 = time.perf_counter()
         n_steps = start_step
+        # update-cache write-back schedule: the periodic flush runs async
+        # (overflow counters queue like the pending losses and are verified
+        # at the same cadence — no extra host sync); checkpoint/eval/epoch
+        # boundaries flush synchronously
+        flush_n = self._flush_every if self._cache_flush is not None else 0
+        next_flush = (n_steps // flush_n + 1) * flush_n if flush_n else None
+        pending_over: list[dict] = []
         next_log = start_step + cfg.log_every_n_steps
         profiled = cfg.profile and epoch == 0 and jax.process_index() == 0
         # train-side streaming AUC on this epoch's predictions, folded ON
@@ -941,6 +991,9 @@ class Trainer:
             snapshot after a clean window."""
             nonlocal loss_sum, contributed, consec_bad, snap, train_auc
             nonlocal steps_at_snap, pending_steps
+            for over in pending_over:
+                _check_cache_overflow(over)
+            pending_over.clear()
             rolled = False
             for loss_dev, k, gstep in pending:
                 v = float(loss_dev)
@@ -1005,6 +1058,12 @@ class Trainer:
                 gstep = self._logged_steps + n_steps
                 pending.append((loss, k, gstep))
                 pending_steps += k
+                if next_flush is not None and n_steps >= next_flush:
+                    # coalesced cache write-back: the ONLY big-table scatter
+                    # in the cadence — one per flush_every steps
+                    self.state, over = self._cache_flush(self.state)
+                    pending_over.append(over)
+                    next_flush = (n_steps // flush_n + 1) * flush_n
                 if pending_steps >= flush_every:
                     flush_checks()
                 if profiled == "tracing" and n_steps >= 20:
@@ -1016,6 +1075,10 @@ class Trainer:
                     # a detected-NaN state rolls back BEFORE the save; force
                     # overwrites a step id a prior (crashed) run already wrote
                     flush_checks()
+                    # cache flush BEFORE the save (post-rollback state):
+                    # checkpoints always hold flushed tables, so restores
+                    # and exports never depend on cache contents
+                    self._flush_cache_sync()
                     self._ckpt.save(
                         gstep, self.state, force=True,
                         cursor={"epoch": epoch, "step": n_steps,
@@ -1064,6 +1127,7 @@ class Trainer:
                     jax.block_until_ready(loss)
                 jax.profiler.stop_trace()
         flush_checks()
+        self._flush_cache_sync()  # epoch boundary: leave the tables flushed
         dt = time.perf_counter() - t0
         ran = n_steps - start_step  # steps actually executed THIS session
         self._logged_steps += n_steps
@@ -1079,9 +1143,21 @@ class Trainer:
         )
         return avg
 
+    def _flush_cache_sync(self) -> None:
+        """Write the update cache back NOW and verify zero admission
+        overflow — the synchronous flush used at checkpoint, eval, and
+        epoch boundaries (no-op when the cache is off)."""
+        if self._cache_flush is None:
+            return
+        self.state, over = self._cache_flush(self.state)
+        _check_cache_overflow(over)
+
     # ----------------------------------------------------------------- eval
 
     def evaluate(self, epoch: int) -> dict[str, float]:
+        # the eval step reads state.tables directly; flush first so it
+        # never sees values the cache holds (bit-equal to an eager run)
+        self._flush_cache_sync()
         with self._jit_ctx():
             if self.config.model == "bert4rec":
                 return self._evaluate_bert4rec(epoch)

@@ -106,6 +106,43 @@ def test_no_default_method_searchsorted_in_hot_code():
         + ", ".join(offenders))
 
 
+def test_no_jnp_unique_in_device_code():
+    """`jnp.unique(size=...)` costs ~0.2 ms at 8k ids / ~0.5 ms at 16k on
+    v5e; the pair-sort + first-mask-cumsum + back-sort formulation
+    (`dedupe_grads`/`dedupe_ids`) does the same job in ~0.24 ms at 16k with
+    2 sorts + 1 small scatter (docs/BUDGET.md).  Device-side dedupe in the
+    hot paths (`ops/`, `parallel/`) must use it — `jnp.unique` creeping
+    back in is a silent multi-x regression.  Host-side numpy unique
+    (preprocessing, metrics, tests) is exempt."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    offenders = []
+    for sub in ("ops", "parallel"):
+        for path in (root / sub).rglob("*.py"):
+            tree = ast.parse(path.read_text(), filename=str(path))
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "unique"):
+                    continue
+                base = node.func.value
+                # jnp.unique / jax.numpy.unique only (np.unique is host-side)
+                is_jnp = (isinstance(base, ast.Name) and base.id == "jnp") or (
+                    isinstance(base, ast.Attribute) and base.attr == "numpy"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "jax")
+                if is_jnp:
+                    offenders.append(f"{path}:{node.lineno}")
+    assert not offenders, (
+        "jnp.unique in device-side hot-path code (use the dedupe_grads/"
+        "dedupe_ids sort formulation — see docs/BUDGET.md): "
+        + ", ".join(offenders))
+
+
 def test_no_precisionless_dots_in_kernel_code():
     """f32 `dot_general` INSIDE Mosaic kernels silently runs bf16 passes at
     default precision (~1e-3 rel error — enough to poison optimizer state;

@@ -1,0 +1,669 @@
+"""Device-resident embedding update cache (software MANAGED_CACHING).
+
+The tentpole contract under test: with ``cache_rows > 0`` every plain
+big-table array routes its per-step row updates through a device-resident
+cache (sorted-id directory + value/slot mirrors riding ``state.slots``),
+admits misses gather-only, serves hits scatter-free, and writes dirty rows
+back in ONE coalesced scatter every ``flush_every`` steps — and the
+trajectory is BIT-IDENTICAL to the eager path for every optimizer kind,
+any flush cadence, and every composition (dedup_lookup, hot/cold, bf16
+storage + stochastic rounding).
+
+Bitwise assertions run the step with ``jit=False``: op-for-op the cached
+math IS the eager math (same operands, same order, same SR key positions),
+which eager execution preserves exactly.  Under jit the cached and eager
+runs are two DIFFERENT XLA programs, and XLA's fusion-dependent FMA
+contraction in the adam mul-add chains drifts ~1 ulp on some inputs — a
+property of comparing any two programs, not of the cache (the jitted test
+pins the params-free sparse half bitwise where contraction is stable, and
+bounds adam at float-eps scale).  Same-program determinism — what
+kill/resume and rollback actually need — is exact and covered by the
+trainer tests below.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tdfo_tpu.models.dlrm import DLRMBackbone
+from tdfo_tpu.ops.sparse import cache_overlay_rows, cache_route, sparse_optimizer
+from tdfo_tpu.parallel.embedding import (
+    CACHE_PREFIX,
+    EmbeddingSpec,
+    ShardedEmbeddingCollection,
+)
+from tdfo_tpu.train.ctr import ctr_sparse_forward
+from tdfo_tpu.train.sparse_step import (
+    SparseTrainState,
+    make_cache_flush_fn,
+    make_sparse_train_step,
+)
+
+CATS = ("c0", "c1", "c2")
+CONTS = ("x0",)
+SIZES = {"c0": 7, "c1": 50, "c2": 300}
+# the three hot/cold routing flavours (tests/test_hot_cold.py): fully hot,
+# contiguous prefix, scattered set
+HOT = {
+    "c0": np.arange(7, dtype=np.int32),
+    "c1": np.arange(8, dtype=np.int32),
+    "c2": np.sort(np.random.default_rng(5).choice(
+        300, size=12, replace=False)).astype(np.int32),
+}
+N_STEPS = 5
+
+
+# ------------------------------------------------------------- unit: ops
+
+
+def test_cache_route_and_overlay():
+    """Directory routing is branch-free: hits return the physical slot,
+    misses/sentinels return C; overlay replaces exactly the hit rows."""
+    opt = sparse_optimizer("sgd", lr=0.1)
+    table = jnp.arange(40, dtype=jnp.float32).reshape(10, 4)
+    cache = opt.cache_init(table, 6)
+    # admit via the public update; zero grads + wd=0 leave values bitwise
+    # equal to the admitted table rows
+    ids = jnp.asarray([3, 7, 2], jnp.int32)
+    cache, _ = opt.cache_update_unique(
+        cache, table, (), ids, jnp.zeros((3, 4)), jnp.ones((3,), bool),
+        step=jnp.int32(0))
+    phys, hit = cache_route(cache, jnp.asarray([2, 5, 7, -1], jnp.int32))
+    np.testing.assert_array_equal(np.asarray(hit), [True, False, True, False])
+    assert int(phys[1]) == 6 and int(phys[3]) == 6  # miss => C
+    # overlay: hit positions show cache rows, misses keep the gathered row
+    rows = jnp.full((4, 4), -1.0)
+    out = np.asarray(cache_overlay_rows(
+        cache, jnp.asarray([2, 5, 7, -1], jnp.int32), rows))
+    assert (out[1] == -1).all() and (out[3] == -1).all()
+    # id 2's cached value: sgd with lr=0.1, g=0 => row unchanged from table
+    np.testing.assert_array_equal(out[0], np.asarray(table)[2])
+    np.testing.assert_array_equal(out[2], np.asarray(table)[7])
+
+
+def test_cache_admission_overflow_is_counted_and_fatal():
+    """Ids past the free directory capacity never enter the cache: the
+    flush reports them and the trainer refuses to continue (their updates
+    would be silently lost)."""
+    from tdfo_tpu.train.trainer import _check_cache_overflow
+
+    opt = sparse_optimizer("sgd", lr=0.1)
+    table = jnp.zeros((64, 4), jnp.float32)
+    cache = opt.cache_init(table, 8)
+    ids = jnp.arange(20, dtype=jnp.int32)  # 20 distinct into 8 slots
+    cache, _ = opt.cache_update_unique(
+        cache, table, (), ids, jnp.ones((20, 4)), jnp.ones((20,), bool),
+        step=jnp.int32(0))
+    cache, table, _, over = opt.cache_flush(cache, table, ())
+    assert int(over) == 12
+    with pytest.raises(RuntimeError, match="cache_rows"):
+        _check_cache_overflow({"t": over})
+    _check_cache_overflow({"t": jnp.zeros((), jnp.int32)})  # clean passes
+
+
+def test_cache_init_shapes_per_kind():
+    table = jnp.zeros((40, 8), jnp.bfloat16)
+    for kind, mirrors in (("sgd", ()), ("adagrad", ("acc",)),
+                          ("rowwise_adagrad", ("acc",)),
+                          ("adam", ("mu", "nu"))):
+        opt = sparse_optimizer(kind, lr=0.1, slot_dtype="bfloat16")
+        c = opt.cache_init(table, 16)
+        assert c["ids"].shape == (16,) and c["rows"].dtype == jnp.bfloat16
+        for m in mirrors:
+            assert m in c
+            if kind == "rowwise_adagrad":
+                assert c[m].shape == (16,) and c[m].dtype == jnp.float32
+            else:
+                assert c[m].shape == (16, 8)
+    with pytest.raises(ValueError, match="2D"):
+        sparse_optimizer("sgd", lr=0.1).cache_init(
+            jnp.zeros((4, 2, 128)), 8)
+
+
+# ---------------------------------------- trajectory bit-equivalence
+
+
+def _run(mesh, kind, dedup, cache_rows, flush_every, *, jit=False,
+         hot=None, dtype=jnp.float32, n=N_STEPS):
+    """Train n steps through the full step path; cached runs flush at the
+    cadence + once at the end so the big tables are authoritative."""
+    specs = [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row",
+                           dtype=dtype) for c in CATS]
+    coll = ShardedEmbeddingCollection(
+        specs, mesh=mesh, stack_tables=True, hot_ids=hot,
+        cache_rows=cache_rows)
+    bb = DLRMBackbone(embed_dim=8, cat_columns=CATS, cont_columns=CONTS)
+    dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in CATS}
+    dummy_c = {c: jnp.zeros((1,), jnp.float32) for c in CONTS}
+    sd = "bfloat16" if dtype == jnp.bfloat16 else "float32"
+    state = SparseTrainState.create(
+        dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adam(1e-2),
+        tables=coll.init(jax.random.key(0)),
+        # threshold below the 357-row stack so adam exercises the cached
+        # sparse tier instead of the small-vocab one-hot tier
+        sparse_opt=sparse_optimizer(kind, lr=1e-2, weight_decay=1e-3,
+                                    small_vocab_threshold=100,
+                                    slot_dtype=sd))
+    flush = None
+    if cache_rows:
+        caches = coll.init_caches(state.tables, state.sparse_opt)
+        assert caches, "collection produced no cacheable arrays"
+        state = dataclasses.replace(state, slots={**state.slots, **caches})
+        flush = make_cache_flush_fn(donate=False, jit=jit)
+    step = make_sparse_train_step(coll, ctr_sparse_forward(bb), donate=False,
+                                  dedup_lookup=dedup, jit=jit)
+    rr = np.random.default_rng(12)
+    losses = []
+    for i in range(n):
+        batch = {c: jnp.asarray(rr.integers(0, SIZES[c], 32), jnp.int32)
+                 for c in CATS}
+        batch["x0"] = jnp.asarray(rr.random(32, dtype=np.float32))
+        batch["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+        state, loss = step(state, batch)
+        losses.append(np.asarray(loss).astype(np.float32).view(np.uint32).item())
+        if flush is not None and (i + 1) % flush_every == 0:
+            state, over = flush(state)
+            assert all(int(v) == 0 for v in over.values()), over
+    if flush is not None:
+        state, over = flush(state)
+        assert all(int(v) == 0 for v in over.values()), over
+    return losses, state, coll
+
+
+def _assert_state_bitwise(s0, s1, ctx=""):
+    for a in s0.tables:
+        x, y = np.asarray(s0.tables[a]), np.asarray(s1.tables[a])
+        v = np.uint16 if x.dtype == jnp.bfloat16 else np.uint32
+        np.testing.assert_array_equal(
+            x.view(v), y.view(v), err_msg=f"{ctx}: table {a}")
+    for a in s0.slots:  # eager slots only — cache entries have no baseline
+        for j, (x, y) in enumerate(zip(
+                jax.tree_util.tree_leaves(s0.slots[a]),
+                jax.tree_util.tree_leaves(s1.slots[a]))):
+            assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), \
+                f"{ctx}: slot {a} leaf {j}"
+
+
+_BASELINES: dict = {}
+
+
+def _baseline(mesh, kind, dedup, **kw):
+    key = (kind, dedup, kw.get("hot") is not None,
+           str(kw.get("dtype", jnp.float32)), kw.get("jit", False))
+    if key not in _BASELINES:
+        _BASELINES[key] = _run(mesh, kind, dedup, 0, 0, **kw)
+    return _BASELINES[key]
+
+
+@pytest.mark.parametrize("kind,dedup,flush_every", [
+    # tier-1 keeps one case per distinct code path (hit-dominated fe=1
+    # with rowwise mirrors, adam's two full mirrors mid-cadence, the
+    # non-dedup forward); the optimizer x cadence cross-product rides the
+    # slow tier — each case is an eager 2x5-step mesh8 run, too heavy to
+    # keep them all in the timed tier
+    ("rowwise_adagrad", True, 1),
+    ("adam", True, 3),
+    ("sgd", False, 3),
+    pytest.param("adagrad", True, 8, marks=pytest.mark.slow),
+    pytest.param("sgd", True, 1, marks=pytest.mark.slow),
+    pytest.param("sgd", True, 8, marks=pytest.mark.slow),
+    pytest.param("adagrad", False, 1, marks=pytest.mark.slow),
+    pytest.param("adagrad", True, 3, marks=pytest.mark.slow),
+    pytest.param("rowwise_adagrad", False, 3, marks=pytest.mark.slow),
+    pytest.param("rowwise_adagrad", True, 8, marks=pytest.mark.slow),
+    pytest.param("adam", False, 8, marks=pytest.mark.slow),
+    pytest.param("adam", True, 1, marks=pytest.mark.slow),
+])
+def test_cache_matches_eager_trajectory(mesh8, kind, dedup, flush_every):
+    """The tentpole bar: same seed, same batches — N cached steps + flushes
+    reproduce the eager run's losses, tables AND optimizer slots
+    bit-for-bit, for every optimizer kind and flush cadence."""
+    l0, s0, _ = _baseline(mesh8, kind, dedup)
+    l1, s1, _ = _run(mesh8, kind, dedup, 1024, flush_every)
+    assert l0 == l1
+    _assert_state_bitwise(s0, s1, f"{kind}/dedup={dedup}/fe={flush_every}")
+
+
+@pytest.mark.parametrize("hot,dtype", [
+    (HOT, jnp.bfloat16),
+    pytest.param(HOT, jnp.float32, marks=pytest.mark.slow),
+    pytest.param(None, jnp.bfloat16, marks=pytest.mark.slow),
+])
+def test_cache_composes_hot_cold_and_bf16(mesh8, hot, dtype):
+    """Composition parity: hot/cold routing (hot heads stay uncached and
+    dense-updated; the cache covers the cold stack) and bf16 storage with
+    stochastic rounding (same SR keys, same noise positions) stay
+    bit-identical to their cache-off runs."""
+    kind, dedup = "rowwise_adagrad", True
+    l0, s0, _ = _baseline(mesh8, kind, dedup, hot=hot, dtype=dtype)
+    l1, s1, coll = _run(mesh8, kind, dedup, 1024, 3, hot=hot, dtype=dtype)
+    assert l0 == l1
+    _assert_state_bitwise(s0, s1, "hot/bf16 composition")
+    if hot is not None:
+        # hot heads are excluded from caching (dense RMW already
+        # scatter-free); the cold stack is covered
+        cached = {k for k in s1.slots if k.startswith(CACHE_PREFIX)}
+        assert cached and all(
+            "__hot" not in k for k in cached), cached
+
+
+@pytest.mark.parametrize("kind", [
+    # each case compiles two distinct mesh8 programs — one representative
+    # (rowwise: the Criteo default) in tier-1, the rest slow
+    "rowwise_adagrad",
+    pytest.param("sgd", marks=pytest.mark.slow),
+    pytest.param("adagrad", marks=pytest.mark.slow),
+    pytest.param("adam", marks=pytest.mark.slow),
+])
+def test_cache_matches_eager_jitted(mesh8, kind):
+    """Jitted cross-program parity on a params-free forward (grads of the
+    embeddings are a fixed function of the batch, isolating the sparse
+    half): bitwise for the kinds whose chains XLA contracts identically;
+    adam's longer mul-add chains FMA-drift ~1 ulp on some inputs, bounded
+    at float-eps scale."""
+
+    def fwd(dense_params, embs, batch):
+        s = sum(jnp.sum(e, axis=-1) for e in embs.values())
+        return jnp.mean((s - batch["label"]) ** 2)
+
+    def run(cache_rows, flush_every):
+        coll = ShardedEmbeddingCollection(
+            [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row")
+             for c in CATS],
+            mesh=mesh8, stack_tables=True, cache_rows=cache_rows)
+        state = SparseTrainState.create(
+            dense_params={}, tx=optax.sgd(1e-2),
+            tables=coll.init(jax.random.key(0)),
+            sparse_opt=sparse_optimizer(kind, lr=1e-2, weight_decay=1e-3,
+                                        small_vocab_threshold=100))
+        flush = None
+        if cache_rows:
+            caches = coll.init_caches(state.tables, state.sparse_opt)
+            state = dataclasses.replace(
+                state, slots={**state.slots, **caches})
+            flush = make_cache_flush_fn(donate=False)
+        step = make_sparse_train_step(coll, fwd, donate=False,
+                                      dedup_lookup=True)
+        rr = np.random.default_rng(12)
+        for i in range(N_STEPS):
+            batch = {c: jnp.asarray(rr.integers(0, SIZES[c], 32), jnp.int32)
+                     for c in CATS}
+            batch["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+            state, _ = step(state, batch)
+            if flush is not None and (i + 1) % 2 == 0:
+                state, over = flush(state)
+                assert all(int(v) == 0 for v in over.values())
+        if flush is not None:
+            state, _ = flush(state)
+        return state
+
+    s0, s1 = run(0, 0), run(1024, 2)
+    for a in s0.tables:
+        x, y = np.asarray(s0.tables[a]), np.asarray(s1.tables[a])
+        if kind == "adam":
+            np.testing.assert_allclose(x, y, rtol=0, atol=1e-6)
+        else:
+            np.testing.assert_array_equal(x.view(np.uint32),
+                                          y.view(np.uint32), err_msg=a)
+
+
+# ------------------------------------------------------------ graph pins
+
+
+def _scatter_operand_dims(closed) -> list[int]:
+    """Leading dim of the updated operand of every scatter in the jaxpr,
+    sub-jaxprs included."""
+    dims = []
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name.startswith("scatter"):
+                dims.append(eqn.invars[0].aval.shape[0])
+            for v in eqn.params.values():
+                for j in jax.tree_util.tree_leaves(
+                        v, is_leaf=lambda x: hasattr(x, "eqns")
+                        or hasattr(x, "jaxpr")):
+                    if hasattr(j, "jaxpr"):
+                        j = j.jaxpr
+                    if hasattr(j, "eqns"):
+                        walk(j)
+
+    walk(closed.jaxpr)
+    return dims
+
+
+def _pin_setup(mesh, cache_rows):
+    coll = ShardedEmbeddingCollection(
+        [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row")
+         for c in CATS],
+        mesh=mesh, stack_tables=True, cache_rows=cache_rows)
+    bb = DLRMBackbone(embed_dim=8, cat_columns=CATS, cont_columns=CONTS)
+    dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in CATS}
+    dummy_c = {c: jnp.zeros((1,), jnp.float32) for c in CONTS}
+    state = SparseTrainState.create(
+        dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adam(1e-2), tables=coll.init(jax.random.key(0)),
+        sparse_opt=sparse_optimizer("rowwise_adagrad", lr=1e-2))
+    step = make_sparse_train_step(coll, ctr_sparse_forward(bb), donate=False,
+                                  dedup_lookup=True, jit=False)
+    rr = np.random.default_rng(0)
+    batch = {c: jnp.asarray(rr.integers(0, SIZES[c], 32), jnp.int32)
+             for c in CATS}
+    batch["x0"] = jnp.asarray(rr.random(32, dtype=np.float32))
+    batch["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+    return coll, state, step, batch
+
+
+def test_nonflush_step_has_no_big_table_scatter(mesh8):
+    """The perf claim, pinned in the IR: with the cache on, the train-step
+    jaxpr contains NO scatter whose updated operand is a big-table-sized
+    array — every scatter lands in cache space (or segment-sum space, both
+    bounded by cache_rows/batch).  The flush program carries the one
+    coalesced big scatter instead."""
+    coll, state, step, batch = _pin_setup(mesh8, 128)
+    caches = coll.init_caches(state.tables, state.sparse_opt)
+    state = dataclasses.replace(state, slots={**state.slots, **caches})
+    v_big = min(t.shape[0] for t in state.tables.values())
+    assert v_big >= 357  # the stacked array (modulo shard padding)
+
+    dims = _scatter_operand_dims(jax.make_jaxpr(step)(state, batch))
+    big = [d for d in dims if d >= v_big]
+    assert not big, f"big-table scatters in the non-flush step: {dims}"
+
+    flush = make_cache_flush_fn(donate=False, jit=False)
+    fdims = _scatter_operand_dims(jax.make_jaxpr(flush)(state))
+    assert any(d >= v_big for d in fdims), \
+        f"flush lost its coalesced big-table scatter: {fdims}"
+
+    # the eager step DOES scatter into the big table (the cost the cache
+    # removes) — proves the pin detects what it claims to
+    _, estate, estep, _ = _pin_setup(mesh8, 0)
+    edims = _scatter_operand_dims(jax.make_jaxpr(estep)(estate, batch))
+    assert any(d >= v_big for d in edims)
+
+
+def test_cache_off_graph_is_byte_identical(mesh8):
+    """cache_rows = 0 must not change the compiled program at all — and a
+    cache_rows > 0 COLLECTION with a cache-free state (the enable signal
+    is the cache entries in state.slots) traces the same bytes too."""
+    import re
+
+    _, state0, step0, batch = _pin_setup(mesh8, 0)
+    _, state8, step8, _ = _pin_setup(mesh8, 8)  # knob set, no cache entries
+    # the jaxpr pretty-printer embeds function-object addresses in pjit /
+    # custom_jvp params — normalize them; everything semantic must match
+    norm = lambda j: re.sub(r"0x[0-9a-f]+", "0xADDR", str(j))
+    j0 = norm(jax.make_jaxpr(step0)(state0, batch))
+    j8 = norm(jax.make_jaxpr(step8)(state8, batch))
+    assert j0 == j8
+
+
+# ------------------------------------------------------------- refusals
+
+
+def test_cache_requires_gspmd_step_and_no_pipelining(mesh8):
+    from tdfo_tpu.train.sparse_step import make_pipelined_sparse_train_step
+
+    coll = ShardedEmbeddingCollection(
+        [EmbeddingSpec("a", 40, 8, features=("a",), sharding="row")],
+        mesh=mesh8, cache_rows=16)
+    with pytest.raises(ValueError, match="gspmd"):
+        make_sparse_train_step(coll, lambda d, e, b: 0.0, mode="alltoall")
+    grouped = ShardedEmbeddingCollection(
+        [EmbeddingSpec("a", 40, 8, features=("a",), sharding="row")],
+        mesh=mesh8, grouped_a2a=True, cache_rows=16)
+    with pytest.raises(ValueError, match="cache"):
+        make_pipelined_sparse_train_step(grouped, lambda d, e, b: 0.0)
+    with pytest.raises(ValueError, match=">= 0"):
+        ShardedEmbeddingCollection(
+            [EmbeddingSpec("a", 40, 8, features=("a",))], cache_rows=-1)
+
+
+def test_cache_config_validation():
+    from tdfo_tpu.core.config import read_configs
+
+    ok = dict(model="dlrm", embeddings={"cache_rows": 1024})
+    cfg = read_configs(None, **ok)
+    assert cfg.embeddings.cache_rows == 1024 and cfg.embeddings.flush_every == 64
+    with pytest.raises(ValueError, match="cache_rows"):
+        read_configs(None, model="dlrm", embeddings={"cache_rows": -1})
+    with pytest.raises(ValueError, match="flush_every"):
+        read_configs(None, model="dlrm",
+                     embeddings={"cache_rows": 8, "flush_every": 0})
+    # regime: dense twotower would silently ignore the knob
+    with pytest.raises(ValueError, match="model_parallel"):
+        read_configs(None, model="twotower", embeddings={"cache_rows": 8})
+    # lookup modes: the cache routes inside the gspmd jitted step only
+    with pytest.raises(ValueError, match="gspmd"):
+        read_configs(None, model="dlrm", model_parallel=True,
+                     lookup_mode="alltoall", embeddings={"cache_rows": 8})
+    # grouped_a2a forces alltoall, transitively refused
+    with pytest.raises(ValueError, match="gspmd|alltoall"):
+        read_configs(None, model="dlrm", model_parallel=True,
+                     embeddings={"cache_rows": 8, "grouped_a2a": True})
+    with pytest.raises(ValueError, match="steps_per_execution"):
+        read_configs(None, model="dlrm", steps_per_execution=4,
+                     embeddings={"cache_rows": 8})
+    with pytest.raises(ValueError, match="pipeline_overlap"):
+        read_configs(None, model="dlrm", train={"pipeline_overlap": True},
+                     embeddings={"cache_rows": 8})
+
+
+# ------------------------------------------- checkpoint stamps + resume
+
+
+def test_cache_stamps_refuse_mismatched_restore(tmp_path):
+    """A cached-run checkpoint carries cache arrays inside slots: restoring
+    across cache_rows/flush_every (either direction) must refuse instead of
+    silently mis-shaping state; legacy stampless checkpoints restore into
+    cache-off runs untouched."""
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+
+    state = {"t": jnp.zeros((4, 8), jnp.float32)}
+    stamp = {"update_cache": {"cache_rows": 1024, "flush_every": 8}}
+    mgr = CheckpointManager(tmp_path / "c")
+    mgr.save(0, state, stamps=stamp)
+    step, _, _ = mgr.restore(state, stamps=dict(stamp))
+    assert step == 0
+    for bad in (None,  # cache-off run reading a cached checkpoint
+                {"update_cache": {"cache_rows": 512, "flush_every": 8}},
+                {"update_cache": {"cache_rows": 1024, "flush_every": 64}}):
+        with pytest.raises(ValueError, match="stamps"):
+            mgr.restore(state, stamps=bad)
+    mgr.close()
+    # other direction: a cached run refuses a legacy/cache-off checkpoint
+    mgr2 = CheckpointManager(tmp_path / "c2")
+    mgr2.save(0, state)
+    s, _, _ = mgr2.restore(state, stamps=None)  # legacy -> cache-off: fine
+    assert s == 0
+    with pytest.raises(ValueError, match="stamps"):
+        mgr2.restore(state, stamps=dict(stamp))
+    mgr2.close()
+
+
+# ------------------------------------------------------- serving export
+
+
+@pytest.mark.slow  # three extra eager mesh8 runs; the flush-before-export
+# invariant it certifies is also exercised by the tier-1 trainer tests
+def test_export_identity_cached_vs_eager(mesh8):
+    """Serving bundles are trajectory artifacts, not schedule artifacts:
+    merged tables from (a) the eager run, (b) the cached run after flush,
+    and (c) the cached run MID-interval with dirty rows + the caches
+    overlay are all bitwise identical."""
+    from tdfo_tpu.serve.export import merged_tables
+
+    kind, dedup = "rowwise_adagrad", True
+    _, s0, coll0 = _baseline(mesh8, kind, dedup)
+    _, s1, coll1 = _run(mesh8, kind, dedup, 1024, 3)  # flushed at the end
+    out0 = merged_tables(coll0, s0.tables)
+    out1 = merged_tables(coll1, s1.tables)
+    for t in out0:
+        np.testing.assert_array_equal(out0[t].view(np.uint32),
+                                      out1[t].view(np.uint32), err_msg=t)
+
+    # mid-interval: never flush periodically, skip the terminal flush by
+    # re-running with flush_every > n and intercepting before the final
+    # flush — reproduce inline for the dirty state
+    specs = [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row")
+             for c in CATS]
+    coll = ShardedEmbeddingCollection(specs, mesh=mesh8, stack_tables=True,
+                                      cache_rows=1024)
+    bb = DLRMBackbone(embed_dim=8, cat_columns=CATS, cont_columns=CONTS)
+    dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in CATS}
+    dummy_c = {c: jnp.zeros((1,), jnp.float32) for c in CONTS}
+    state = SparseTrainState.create(
+        dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adam(1e-2), tables=coll.init(jax.random.key(0)),
+        sparse_opt=sparse_optimizer(kind, lr=1e-2, weight_decay=1e-3,
+                                    small_vocab_threshold=100))
+    caches = coll.init_caches(state.tables, state.sparse_opt)
+    state = dataclasses.replace(state, slots={**state.slots, **caches})
+    step = make_sparse_train_step(coll, ctr_sparse_forward(bb), donate=False,
+                                  dedup_lookup=dedup, jit=False)
+    rr = np.random.default_rng(12)
+    for _ in range(N_STEPS):
+        batch = {c: jnp.asarray(rr.integers(0, SIZES[c], 32), jnp.int32)
+                 for c in CATS}
+        batch["x0"] = jnp.asarray(rr.random(32, dtype=np.float32))
+        batch["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+        state, _ = step(state, batch)
+    live_caches = {k: v for k, v in state.slots.items()
+                   if k.startswith(CACHE_PREFIX)}
+    assert any(bool(np.asarray(c["dirty"]).any())
+               for c in live_caches.values()), "no dirty rows to overlay"
+    out2 = merged_tables(coll, state.tables, live_caches)
+    for t in out0:
+        np.testing.assert_array_equal(out0[t].view(np.uint32),
+                                      out2[t].view(np.uint32), err_msg=t)
+    # without the overlay the stale big table shows — the caches param is
+    # load-bearing, not decorative
+    out_stale = merged_tables(coll, state.tables)
+    assert any((out_stale[t].view(np.uint32)
+                != out0[t].view(np.uint32)).any() for t in out0)
+
+
+# ------------------------------------------------------ trainer end to end
+
+
+@pytest.fixture(scope="module")
+def cache_data(tmp_path_factory):
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+
+    d = tmp_path_factory.mktemp("gr_cache")
+    write_synthetic_goodreads(d, n_users=80, n_books=120,
+                              interactions_per_user=(15, 40), seed=7)
+    ctr = run_ctr_preprocessing(d)
+    return d, ctr
+
+
+def _trainer_cfg(d, ctr, **kw):
+    from tdfo_tpu.core.config import read_configs
+
+    return read_configs(
+        None, data_dir=d, model="twotower", model_parallel=True,
+        mesh={"data": 4, "model": 2}, n_epochs=1, learning_rate=3e-3,
+        embed_dim=8, per_device_train_batch_size=16,
+        per_device_eval_batch_size=16, shuffle_buffer_size=500,
+        log_every_n_steps=2, size_map=ctr,
+        sparse_optimizer="rowwise_adagrad", **kw)
+
+
+@pytest.mark.slow  # two full fits
+def test_trainer_cache_matches_eager_run(cache_data, tmp_path):
+    """Trainer-level knob semantics: a cached fit (flush_every=3, so the
+    epoch crosses several flush boundaries + the pre-eval sync flush)
+    produces the same metrics as the cache-off fit, and the cache actually
+    engaged (cache entries in slots, flush program built)."""
+    import math
+
+    from tdfo_tpu.train.trainer import Trainer
+
+    d, ctr = cache_data
+    tr_off = Trainer(_trainer_cfg(d, ctr), log_dir=tmp_path / "off")
+    m_off = tr_off.fit()
+    tr_on = Trainer(
+        _trainer_cfg(d, ctr,
+                     embeddings={"cache_rows": 512, "flush_every": 3}),
+        log_dir=tmp_path / "on")
+    m_on = tr_on.fit()
+    assert tr_on._cache_flush is not None
+    assert any(k.startswith(CACHE_PREFIX) for k in tr_on.state.slots)
+    assert not any(k.startswith(CACHE_PREFIX) for k in tr_off.state.slots)
+    assert set(m_on) == set(m_off)
+    for k in m_off:
+        assert math.isfinite(m_on[k])
+        # same trajectory modulo cross-program FMA contraction (see module
+        # docstring); the jit=False tests above pin exact bits
+        np.testing.assert_allclose(m_on[k], m_off[k], rtol=1e-4, atol=1e-6,
+                                   err_msg=k)
+    # post-fit tables are flushed (the epoch-end sync flush): dirty empty
+    for k, c in tr_on.state.slots.items():
+        if k.startswith(CACHE_PREFIX):
+            assert not np.asarray(c["dirty"]).any()
+
+
+def test_trainer_cache_overflow_fails_loudly(cache_data, tmp_path):
+    """An undersized cache must kill the run with the overflow diagnostic,
+    not silently drop updates."""
+    from tdfo_tpu.train.trainer import Trainer
+
+    d, ctr = cache_data
+    tr = Trainer(
+        _trainer_cfg(d, ctr,
+                     embeddings={"cache_rows": 8, "flush_every": 3}),
+        log_dir=tmp_path / "log")
+    with pytest.raises(RuntimeError, match="overflow"):
+        tr.fit()
+
+
+@pytest.mark.slow  # three full fits + checkpoint roundtrips
+def test_trainer_kill_resume_mid_flush_interval(cache_data, tmp_path,
+                                                monkeypatch):
+    """Kill/resume INSIDE a flush interval (checkpoint at step 3, flush
+    cadence 5): the pre-save sync flush makes the checkpoint authoritative,
+    the cache arrays restore through state.slots, and the resumed run lands
+    bit-identical to the uninterrupted reference."""
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+    from tdfo_tpu.train.trainer import Trainer
+    from tdfo_tpu.utils import faults
+
+    d, ctr = cache_data
+
+    class Killed(SystemExit):
+        pass
+
+    monkeypatch.setattr(faults.os, "_exit",
+                        lambda code: (_ for _ in ()).throw(Killed(code)))
+    emb = {"cache_rows": 512, "flush_every": 5}
+    base = dict(checkpoint_dir=str(tmp_path / "ckpt"),
+                checkpoint_every_n_steps=3, embeddings=emb,
+                faults={"kill_at_step": 5})
+    with pytest.raises(Killed):
+        Trainer(_trainer_cfg(d, ctr, **base), log_dir=tmp_path / "l1").fit()
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    s = mgr.latest_step()
+    cursor = mgr.read_cursor(s)
+    mgr.close()
+    assert cursor is not None and not cursor["epoch_complete"]
+    assert cursor["step"] == 3  # mid-epoch AND mid-flush-interval
+
+    tr2 = Trainer(_trainer_cfg(d, ctr, **base), log_dir=tmp_path / "l2")
+    m_resumed = tr2.fit()
+
+    tr_ref = Trainer(
+        _trainer_cfg(d, ctr, checkpoint_dir=str(tmp_path / "ckpt_ref"),
+                     checkpoint_every_n_steps=3, embeddings=dict(emb)),
+        log_dir=tmp_path / "l3")
+    m_ref = tr_ref.fit()
+
+    assert m_resumed == m_ref
+    for a, b in zip(jax.tree.leaves(tr2.state), jax.tree.leaves(tr_ref.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
