@@ -188,6 +188,23 @@ def build_train_bench(batch_size: int, embed_dim: int):
     # inner step WITHOUT donation: every chained execution must be free to
     # start from the same persistent state buffers.
     inner = make_train_step(mesh=mesh, donate_state=False)
+    # unjitted twin for the one-off counters probe (a collector cannot see
+    # through an inner jit boundary)
+    probe_inner = make_train_step(mesh=mesh, donate_state=False, jit=False)
+
+    def counters_probe(seed: int = 7) -> dict[str, float]:
+        from tdfo_tpu.obs import counters as obs_counters
+
+        @jax.jit
+        def one(state, batch):
+            with obs_counters.collect() as c:
+                _, loss = probe_inner(state, batch)
+            return loss, dict(c)
+
+        host = _make_host_batch(np.random.default_rng(seed), b)
+        stack = _stack_batches(mesh, host, 1, b)
+        _, ctrs = one(state, {k: v[0] for k, v in stack.items()})
+        return {k: round(float(v), 3) for k, v in ctrs.items()}
 
     def run(k):
         @jax.jit
@@ -209,7 +226,7 @@ def build_train_bench(batch_size: int, embed_dim: int):
     param_bytes = sum(leaf.nbytes for leaf in jax.tree.leaves(state.params))
     floor_bytes = 6.0 * param_bytes
     flops_per_example = dense_flops_per_example(state.params)
-    return run, make_args, b, floor_bytes, flops_per_example
+    return run, make_args, b, floor_bytes, flops_per_example, counters_probe
 
 
 # Why the sparse headline sits far above its BYTE-roofline floor: the floor
@@ -340,6 +357,30 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int,
 
         return lambda stack: chain(dense, stack)
 
+    def counters_probe(seed: int = 7) -> dict[str, float]:
+        # one counters-on step (telemetry registry riding the real step):
+        # per-table touched/unique rows + grad/param norms in the record.
+        # The TIMED chain above stays counters-off — byte-identical program.
+        from tdfo_tpu.obs import counters as obs_counters
+
+        @jax.jit
+        def one(dense, batch):
+            tables = {n: jnp.zeros(sh.shape, sh.dtype)
+                      for n, sh in table_shapes.items()}
+            state = SparseTrainState.create(
+                dense_params=dense,
+                tx=optax.adamw(3e-4, weight_decay=1e-4),
+                tables=tables, sparse_opt=opt)
+            with obs_counters.collect() as c:
+                _, loss = inner(state, batch)
+            return loss, dict(c)
+
+        r = np.random.default_rng(seed)
+        host = _make_criteo_host_batch(r, b, powerlaw=powerlaw)
+        stack = _stack_batches(mesh, host, 1, b)
+        _, ctrs = one(dense, {k: v[0] for k, v in stack.items()})
+        return {k: round(float(v), 3) for k, v in ctrs.items()}
+
     unique_rows_per_step: list[float] = []
     hot_k = {c: coll.hot_count(f"{c}_embed") for c in cats}
     hot_info = {
@@ -385,7 +426,8 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int,
                         for k_ in hot_k.values())
         return 2.0 * u_mean * lay.w * 4.0 + 6.0 * dense_bytes + hot_bytes
 
-    return run, make_args, b, floor_bytes_fn, flops_per_example, hot_info
+    return (run, make_args, b, floor_bytes_fn, flops_per_example, hot_info,
+            counters_probe)
 
 
 def build_sparse_train_bench(batch_size: int, embed_dim: int,
@@ -453,6 +495,20 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int,
         coll, ctr_sparse_forward(backbone), jit=False, donate=False
     )
 
+    def counters_probe(seed: int = 7) -> dict[str, float]:
+        from tdfo_tpu.obs import counters as obs_counters
+
+        @jax.jit
+        def one(state, batch):
+            with obs_counters.collect() as c:
+                _, loss = inner(state, batch)
+            return loss, dict(c)
+
+        host = _make_host_batch(np.random.default_rng(seed), b)
+        stack = _stack_batches(mesh, host, 1, b)
+        _, ctrs = one(state, {k: v[0] for k, v in stack.items()})
+        return {k: round(float(v), 3) for k, v in ctrs.items()}
+
     def run(k):
         @jax.jit
         def chain(state, stack):
@@ -489,7 +545,8 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int,
         per_row = 2.0 * t_item + 4.0 * 4.0
         return per_row * u_mean * embed_dim + 6.0 * dense_bytes
 
-    return run, make_args, b, floor_bytes_fn, flops_per_example, table_bytes
+    return (run, make_args, b, floor_bytes_fn, flops_per_example, table_bytes,
+            counters_probe)
 
 
 def bench_embedding_lookup(batch_size: int = 8192, vocab: int = 2_000_000,
@@ -1002,17 +1059,18 @@ def main() -> None:
     hot_info = None
     table_bytes = None
     if args.dense:
-        run, make_args, global_batch, floor_bytes, flops_per_ex = build_train_bench(
-            args.batch_size, args.embed_dim
-        )
+        (run, make_args, global_batch, floor_bytes, flops_per_ex,
+         counters_probe) = build_train_bench(args.batch_size, args.embed_dim)
     elif args.model == "dlrm-criteo":
-        run, make_args, global_batch, floor_bytes, flops_per_ex, hot_info = (
+        (run, make_args, global_batch, floor_bytes, flops_per_ex, hot_info,
+         counters_probe) = (
             build_criteo_train_bench(args.batch_size, args.embed_dim,
                                      hot_vocab=args.hot_vocab,
                                      powerlaw=args.powerlaw)
         )
     else:
-        run, make_args, global_batch, floor_bytes, flops_per_ex, table_bytes = (
+        (run, make_args, global_batch, floor_bytes, flops_per_ex, table_bytes,
+         counters_probe) = (
             build_sparse_train_bench(args.batch_size, args.embed_dim,
                                      args.model, args.table_dtype)
         )
@@ -1039,6 +1097,16 @@ def main() -> None:
     examples_per_sec_per_chip = global_batch / sec_per_step / n_chips
     mfu = (flops_per_ex * global_batch / sec_per_step) / (n_chips * peak_tflops * 1e12)
     hbm_util = floor_bytes / sec_per_step / (hbm_gbps * 1e9)
+
+    # one counters-on step AFTER the timed chains: the telemetry registry's
+    # per-step numbers (touched/unique rows per table, grad/param norms) in
+    # the record, from a separate program — the timed program stays
+    # counters-off (byte-identity pinned by tests/test_telemetry.py)
+    try:
+        step_counters = counters_probe()
+    except Exception as e:  # the probe must never kill the headline
+        print(f"bench: counters probe failed: {e!r}", file=sys.stderr)
+        step_counters = {}
 
     lookup = {} if args.skip_lookup_bench else bench_embedding_lookup()
 
@@ -1101,6 +1169,7 @@ def main() -> None:
         "bytes_per_step": round(floor_bytes, 1),
         "hbm_utilization": round(hbm_util, 3),
         "mfu": round(mfu, 5),
+        "counters": step_counters,
         "embedding_lookup_p50_us": lookup,
         "big_table_demo": big_table,
         "serving": serving,

@@ -35,6 +35,7 @@ __all__ = [
     "FaultSpec",
     "EmbeddingsSpec",
     "ServingSpec",
+    "TelemetrySpec",
     "TrainSpec",
     "read_configs",
     "load_size_map",
@@ -201,6 +202,39 @@ class TrainSpec:
 
 
 @dataclass(frozen=True)
+class TelemetrySpec:
+    """``[telemetry]`` config table: flight-recorder knobs (``tdfo_tpu/obs``).
+
+    The reference's only observability is tqdm bars and a
+    ``tf.keras.callbacks.TensorBoard`` callback (``tensorflow2/
+    train_ps.py:154``); torchrec's production analogue is ``TrainPipeline``
+    throughput logging.  Every key is observable
+    (``tests/test_telemetry.py``).
+    """
+
+    # in-graph step diagnostics (per-table touched/unique rows, cache
+    # hit/miss/dirty/flushed, a2a fill/overflow, grad/param norms,
+    # nonfinite logits) carried alongside the pending losses — zero extra
+    # host syncs, fetched at log cadence into metrics.jsonl (+ TB when
+    # tensorboard = true).  false compiles a byte-identical step jaxpr
+    # (pinned by test) so the default path cannot regress.
+    counters: bool = False
+    # compile/retrace + memory events: every jax compilation (name,
+    # duration, per-name count) appends to <log_dir>/events.jsonl;
+    # compilations after warmup are flagged as unexpected retraces with a
+    # loud warning, and device.memory_stats() live/peak bytes are sampled
+    # at log cadence with a run-peak watermark in the final summary
+    # (no-op on backends without memory_stats, e.g. spoofed CPU devices).
+    events: bool = False
+    # stall watchdog: a daemon thread appends {last_step, step_age_s} to
+    # <log_dir>/heartbeat.jsonl and logs a LOUD warning with every
+    # thread's Python stack when no train step completes within this many
+    # seconds (the "tunnel hung >180 s" failure mode, made diagnosable).
+    # 0 disables the watchdog thread (heartbeat.jsonl is not written).
+    stall_timeout_s: float = 0.0
+
+
+@dataclass(frozen=True)
 class Config:
     """Unified training configuration.
 
@@ -324,6 +358,8 @@ class Config:
     train: TrainSpec = field(default_factory=TrainSpec)
     # [serving] table: online-inference knobs (launch serve / tdfo_tpu.serve)
     serving: ServingSpec = field(default_factory=ServingSpec)
+    # [telemetry] table: flight-recorder knobs (tdfo_tpu/obs)
+    telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---
@@ -553,6 +589,9 @@ class Config:
                 "serving max_batch must fit the largest bucket: a full batch "
                 f"of {self.serving.max_batch} rows cannot pad into "
                 f"buckets[-1] = {self.serving.buckets[-1]}")
+        if self.telemetry.stall_timeout_s < 0:
+            raise ValueError(
+                "telemetry stall_timeout_s must be >= 0 (0 = watchdog off)")
         if self.train.pipeline_overlap:
             if not self.embeddings.grouped_a2a:
                 raise ValueError(
@@ -601,6 +640,7 @@ _FAULT_FIELDS = {f.name for f in dataclasses.fields(FaultSpec)}
 _EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
 _TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainSpec)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingSpec)}
+_TELEMETRY_FIELDS = {f.name for f in dataclasses.fields(TelemetrySpec)}
 
 
 def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
@@ -670,6 +710,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                                buckets=tuple(serving_raw["buckets"]))
         serving = ServingSpec(**serving_raw)
 
+    telemetry_raw = raw.pop("telemetry", {})
+    if isinstance(telemetry_raw, TelemetrySpec):
+        telemetry = telemetry_raw
+    else:
+        unknown_telemetry = set(telemetry_raw) - _TELEMETRY_FIELDS
+        if unknown_telemetry:
+            raise ValueError(
+                f"unknown telemetry config keys: {sorted(unknown_telemetry)}")
+        telemetry = TelemetrySpec(**telemetry_raw)
+
     unknown = set(raw) - _CONFIG_FIELDS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -681,7 +731,7 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
     cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, train=train,
-                 serving=serving, **raw)
+                 serving=serving, telemetry=telemetry, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

@@ -45,8 +45,27 @@ __all__ = [
     "SEQ_AXIS",
 ]
 
+def _suppress_counters(f):
+    # Telemetry counters (tdfo_tpu/obs/counters.py) may not be emitted from
+    # inside a shard_map body: the per-shard tracer would leak out through
+    # the side collector instead of being a declared output.  Every body
+    # therefore runs suppressed; sites needing per-shard diagnostics declare
+    # them as real shard_map outputs and emit from the caller.
+    @functools.wraps(f)
+    def suppressed(*args, **kwargs):
+        from tdfo_tpu.obs import counters
+
+        with counters.suppress():
+            return f(*args, **kwargs)
+
+    return suppressed
+
+
 try:  # jax >= 0.5 exports shard_map at top level
-    shard_map = jax.shard_map
+    _shard_map_impl = jax.shard_map
+
+    def shard_map(f, *args, **kwargs):
+        return _shard_map_impl(_suppress_counters(f), *args, **kwargs)
 except AttributeError:
     # 0.4.x: same callable in the experimental namespace, with the
     # replication check still spelled check_rep instead of check_vma
@@ -55,7 +74,7 @@ except AttributeError:
     def shard_map(f, *args, **kwargs):
         if "check_vma" in kwargs:
             kwargs["check_rep"] = kwargs.pop("check_vma")
-        return _shard_map_exp(f, *args, **kwargs)
+        return _shard_map_exp(_suppress_counters(f), *args, **kwargs)
 
 try:  # jax >= 0.5
     axis_size = jax.lax.axis_size

@@ -1,0 +1,17 @@
+"""Flight-recorder telemetry: in-graph counters, compile/memory events,
+and the stall watchdog (ISSUE 7).
+
+Three independent pieces behind the validated ``[telemetry]`` config table:
+
+- ``counters``  — trace-time collector registry the train/sparse steps emit
+  device-computed diagnostics through; zero jaxpr footprint when off.
+- ``events``    — process-global compile/retrace recorder + device memory
+  sampler appending to ``events.jsonl``.
+- ``watchdog``  — daemon thread writing ``heartbeat.jsonl`` and dumping all
+  thread stacks when no step completes within the stall timeout.
+"""
+
+from tdfo_tpu.obs import counters, events
+from tdfo_tpu.obs.watchdog import StallWatchdog
+
+__all__ = ["counters", "events", "StallWatchdog"]

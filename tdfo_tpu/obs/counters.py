@@ -1,0 +1,98 @@
+"""Trace-time counter registry for in-graph step diagnostics.
+
+The train/sparse steps are built from layered helpers (`ops/sparse.py`
+cache math, `parallel/embedding.py` exchanges, the step bodies themselves)
+that would each need a threaded-through accumulator argument to report
+diagnostics.  Instead, emission sites call :func:`emit` unconditionally and
+a *collector* — a plain dict pushed onto a module-level stack while the
+step function is being TRACED — decides whether anything happens:
+
+- no collector active (the default, ``telemetry.counters=false``): ``emit``
+  returns immediately without evaluating its value thunk, so the traced
+  jaxpr is byte-identical to a build with no telemetry code at all
+  (pinned by ``tests/test_telemetry.py``);
+- a collector active: the thunk runs under the ambient trace and the
+  resulting tracer is recorded; the step wrapper returns the dict as an
+  extra pytree output, so counter values ride the SAME device buffers and
+  host fetches as the pending losses — no extra syncs.
+
+Two scoping rules keep tracers from leaking across trace boundaries:
+``core/mesh.py`` wraps every `shard_map` body in :func:`suppress` (a tracer
+born inside manual-SPMD cannot escape via a side dict — sites that need
+per-shard counters declare them as real shard_map outputs and emit from
+the caller), and multi-step `lax.scan` bodies open their OWN collector
+inside the body, stacking counters as scan outputs (`train/step.py`,
+`train/trainer.py`).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Union
+
+import jax.numpy as jnp
+
+# Stack of active collectors.  ``None`` entries mark suppressed regions
+# (shard_map bodies): emission is disabled but the stack depth still
+# records that a trace boundary was crossed.
+_STACK: list = []
+_PREFIX: list = []
+
+
+def enabled() -> bool:
+    """True when the innermost region has a live collector."""
+    return bool(_STACK) and _STACK[-1] is not None
+
+
+def emit(name: str, value: Union[Callable, object]) -> None:
+    """Record ``value`` under ``name`` in the active collector, if any.
+
+    ``value`` may be a zero-arg thunk — it is ONLY called when a collector
+    is active, so emission sites add zero equations to the counters-off
+    jaxpr (the byte-identity contract).  Values are coerced to f32 scalars
+    so every counter pytree leaf has one dtype/shape (cross-step stacking
+    under scan, single fetch at log time).
+    """
+    if not enabled():
+        return
+    if callable(value):
+        value = value()
+    _STACK[-1]["".join(_PREFIX) + name] = jnp.asarray(value, jnp.float32)
+
+
+@contextlib.contextmanager
+def collect():
+    """Open a collector; yields the dict that ``emit`` fills during the
+    enclosed trace."""
+    out: dict = {}
+    _STACK.append(out)
+    try:
+        yield out
+    finally:
+        _STACK.pop()
+
+
+@contextlib.contextmanager
+def suppress():
+    """Disable emission for the enclosed region (shard_map bodies)."""
+    if not _STACK:
+        # Nothing to suppress — keep the common counters-off path free of
+        # stack churn.
+        yield
+        return
+    _STACK.append(None)
+    try:
+        yield
+    finally:
+        _STACK.pop()
+
+
+@contextlib.contextmanager
+def scope(prefix: str):
+    """Prefix counter names emitted in the enclosed region
+    (``emb/<table>/touched`` style namespacing)."""
+    _PREFIX.append(prefix)
+    try:
+        yield
+    finally:
+        _PREFIX.pop()

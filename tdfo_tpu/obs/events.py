@@ -1,0 +1,210 @@
+"""Compile/retrace + device-memory event recorder (``events.jsonl``).
+
+jax announces every backend compilation and trace through
+``jax._src.dispatch.log_elapsed_time`` ("Finished XLA compilation of
+{fun_name} in {t} sec", DEBUG unless ``jax_log_compiles``).  Rather than
+flipping the global log-compiles flag (stderr spam), :func:`configure`
+attaches one DEBUG-level handler to that logger — propagation is disabled
+while recording so the DEBUG flood never reaches jax's own stderr handler,
+with anything at the logger's original threshold forwarded on — and parses
+the records:
+every compilation lands in ``events.jsonl`` with its name, duration, and
+per-name count, and compilations AFTER :func:`mark_warmup` are flagged
+``after_warmup`` with a loud warning (a retrace in steady state means a
+shape/dtype leak — the serve frontend's bounded-jit-cache invariant).
+
+Process-global, configured once per run like ``utils/faults.configure``.
+``memory_snapshot`` samples ``device.memory_stats()`` live/peak bytes
+(None on spoofed CPU devices — gated) and keeps a run-peak watermark for
+the final summary.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_JAX_LOGGER_NAME = "jax._src.dispatch"
+_COMPILE_RE = re.compile(
+    r"Finished XLA compilation of (.+?) in ([0-9.eE+-]+) sec")
+_TRACE_RE = re.compile(
+    r"Finished tracing \+ transforming (.+?) (?:for pmap )?in "
+    r"([0-9.eE+-]+) sec")
+
+_LOCK = threading.Lock()
+_ACTIVE: Optional["_Recorder"] = None
+
+
+class _Recorder:
+    def __init__(self, path):
+        self.path = os.fspath(path)
+        self.after_warmup = False
+        self.counts: dict = {}   # (kind, name) -> occurrences
+        self.peak_bytes: dict = {}  # device label -> max bytes_in_use seen
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # truncate: one recorder per run, the file is the run's event log
+        open(self.path, "w").close()
+
+    def record(self, kind: str, **fields) -> None:
+        rec = {"time": time.time(), "kind": kind, **fields}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+class _JaxCompileHandler(logging.Handler):
+    """Parses dispatch's compile/trace announcements into event records."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        rec = _ACTIVE
+        if rec is not None:
+            try:
+                msg = record.getMessage()
+            except Exception:
+                msg = None
+            if msg is not None:
+                self._parse(rec, msg)
+        # Propagation is off while we hold the logger at DEBUG (jax mounts
+        # a level-NOTSET stderr handler on the "jax" logger, so the DEBUG
+        # flood we enable would spam the console).  Records that cleared
+        # the logger's ORIGINAL threshold — real warnings, or compile
+        # announcements promoted to WARNING by jax_log_compiles — still
+        # flow to the parent chain here.
+        if _FWD_LEVEL is not None and record.levelno >= _FWD_LEVEL:
+            parent = logging.getLogger(_JAX_LOGGER_NAME).parent
+            if parent is not None:
+                parent.handle(record)
+
+    @staticmethod
+    def _parse(rec: "_Recorder", msg: str) -> None:
+        for kind, rx in (("compile", _COMPILE_RE), ("trace", _TRACE_RE)):
+            m = rx.search(msg)
+            if not m:
+                continue
+            name, dur = m.group(1), float(m.group(2))
+            with _LOCK:
+                n = rec.counts[(kind, name)] = rec.counts.get(
+                    (kind, name), 0) + 1
+                late = rec.after_warmup
+                rec.record(kind, name=name, duration_s=dur, count=n,
+                           after_warmup=late)
+            if late and kind == "compile":
+                logger.warning(
+                    "UNEXPECTED RETRACE: %s compiled after warmup "
+                    "(occurrence %d, %.3fs) — a shape/dtype leak is "
+                    "invalidating the jit cache", name, n, dur)
+            return
+
+
+_HANDLER: Optional[_JaxCompileHandler] = None
+_SAVED_LEVEL: Optional[int] = None
+_SAVED_PROPAGATE: Optional[bool] = None
+_FWD_LEVEL: Optional[int] = None
+
+
+def configure(path=None) -> None:
+    """Start recording to ``path`` (``events.jsonl``); ``None`` stops."""
+    global _ACTIVE, _HANDLER, _SAVED_LEVEL, _SAVED_PROPAGATE, _FWD_LEVEL
+    jl = logging.getLogger(_JAX_LOGGER_NAME)
+    with _LOCK:
+        if path is None:
+            _ACTIVE = None
+            if _HANDLER is not None:
+                jl.removeHandler(_HANDLER)
+                _HANDLER = None
+            if _SAVED_LEVEL is not None:
+                jl.setLevel(_SAVED_LEVEL)
+                _SAVED_LEVEL = None
+            if _SAVED_PROPAGATE is not None:
+                jl.propagate = _SAVED_PROPAGATE
+                _SAVED_PROPAGATE = None
+            _FWD_LEVEL = None
+            return
+        _ACTIVE = _Recorder(path)
+        if _HANDLER is None:
+            _HANDLER = _JaxCompileHandler(level=logging.DEBUG)
+            _SAVED_LEVEL = jl.level
+            _SAVED_PROPAGATE = jl.propagate
+            _FWD_LEVEL = jl.getEffectiveLevel()
+            # dispatch logs at DEBUG unless jax_log_compiles; the logger
+            # must pass DEBUG for the records to exist at all.  Propagation
+            # goes off so the flood stays out of jax's stderr handler; the
+            # handler forwards anything at the original threshold.
+            jl.setLevel(logging.DEBUG)
+            jl.propagate = False
+            jl.addHandler(_HANDLER)
+
+
+def active() -> bool:
+    return _ACTIVE is not None
+
+
+def mark_warmup() -> None:
+    """Declare warmup over: later compilations are unexpected retraces."""
+    rec = _ACTIVE
+    if rec is not None:
+        rec.after_warmup = True
+        rec.record("warmup_done")
+
+
+def record(kind: str, **fields) -> None:
+    """Append an arbitrary event (checkpoint, epoch boundary, stall...)."""
+    rec = _ACTIVE
+    if rec is not None:
+        with _LOCK:
+            rec.record(kind, **fields)
+
+
+def compile_count(name_substr: Optional[str] = None) -> int:
+    """Total compilations recorded (optionally filtered by name substring)."""
+    rec = _ACTIVE
+    if rec is None:
+        return 0
+    with _LOCK:
+        return sum(n for (kind, name), n in rec.counts.items()
+                   if kind == "compile"
+                   and (name_substr is None or name_substr in name))
+
+
+def memory_snapshot(devices=None) -> Optional[list]:
+    """Sample per-device live/peak bytes; None when the backend exposes no
+    ``memory_stats`` (spoofed CPU devices).  Updates the run-peak
+    watermark and appends a ``memory`` event when recording."""
+    import jax
+
+    stats = []
+    for d in (devices if devices is not None else jax.local_devices()):
+        s = d.memory_stats() if hasattr(d, "memory_stats") else None
+        if not s:
+            continue
+        stats.append({
+            "device": str(d),
+            "bytes_in_use": int(s.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(s.get("peak_bytes_in_use", 0)),
+        })
+    if not stats:
+        return None
+    rec = _ACTIVE
+    if rec is not None:
+        with _LOCK:
+            for s in stats:
+                rec.peak_bytes[s["device"]] = max(
+                    rec.peak_bytes.get(s["device"], 0),
+                    s["peak_bytes_in_use"] or s["bytes_in_use"])
+            rec.record("memory", devices=stats)
+    return stats
+
+
+def peak_memory() -> dict:
+    """Run-peak watermark per device (final-summary material)."""
+    rec = _ACTIVE
+    if rec is None:
+        return {}
+    with _LOCK:
+        return dict(rec.peak_bytes)

@@ -1,0 +1,113 @@
+"""Stall watchdog: heartbeat file + all-thread stack dump on hang.
+
+The "tunnel hung >180 s" failure mode is a silent wedge — the train loop
+blocks inside a value fetch and nothing is ever printed.  The watchdog is
+a daemon thread that wakes every ``timeout_s / 4`` seconds, appends the
+last completed step and its age to ``heartbeat.jsonl``, and when no step
+has completed within ``timeout_s`` logs a LOUD warning with the Python
+stack of every live thread (``sys._current_frames``) so the hang site is
+diagnosable post-mortem from the log alone.
+
+Wall-clock deltas here are sanctioned: the watchdog times the HOST loop
+(did a step complete?), not device execution — the dishonest-timing rule
+(CLAUDE.md, ``test_quality.py``) is about differencing around device
+work.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+
+logger = logging.getLogger(__name__)
+
+
+class StallWatchdog:
+    """Heartbeat writer + stall detector.
+
+    ``beat(step)`` is called by the train loop each completed step; the
+    daemon thread does everything else.  Re-arms after each stall so a
+    recovered loop gets fresh detection.
+    """
+
+    def __init__(self, heartbeat_path, timeout_s: float, *,
+                 clock=time.monotonic):
+        self.path = os.fspath(heartbeat_path)
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._last_step = -1
+        self._last_beat = clock()
+        self._stalled = False
+        self.stall_events: list = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        open(self.path, "w").close()
+
+    # ----------------------------------------------------------- loop API
+
+    def beat(self, step: int) -> None:
+        with self._lock:
+            self._last_step = int(step)
+            self._last_beat = self._clock()
+            self._stalled = False
+
+    def start(self) -> "StallWatchdog":
+        if self.timeout_s > 0 and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tdfo-stall-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.timeout_s)
+            self._thread = None
+
+    # ------------------------------------------------------------ daemon
+
+    def _write(self, rec: dict) -> None:
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def check(self) -> bool:
+        """One watchdog pass (the daemon's body; callable from tests).
+        Returns True when a stall was detected on this pass."""
+        with self._lock:
+            step, age = self._last_step, self._clock() - self._last_beat
+            fresh_stall = age > self.timeout_s and not self._stalled
+            if fresh_stall:
+                self._stalled = True
+        self._write({"time": time.time(), "last_step": step,
+                     "step_age_s": age, "stalled": age > self.timeout_s})
+        if fresh_stall:
+            dump = self._dump_stacks()
+            self.stall_events.append(
+                {"last_step": step, "step_age_s": age})
+            self._write({"time": time.time(), "kind": "stall",
+                         "last_step": step, "step_age_s": age,
+                         "stacks": dump})
+            logger.warning(
+                "STALL: no step completed in %.1fs (last step %d). "
+                "Thread stacks:\n%s", age, step, dump)
+        return fresh_stall
+
+    def _dump_stacks(self) -> str:
+        names = {t.ident: t.name for t in threading.enumerate()}
+        parts = []
+        for tid, frame in sys._current_frames().items():
+            parts.append(f"--- thread {names.get(tid, '?')} ({tid}) ---\n"
+                         + "".join(traceback.format_stack(frame)))
+        return "\n".join(parts)
+
+    def _run(self) -> None:
+        interval = max(self.timeout_s / 4.0, 0.05)
+        while not self._stop.wait(interval):
+            self.check()

@@ -28,6 +28,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
+from tdfo_tpu.obs import counters
 from tdfo_tpu.ops.quant import component_key, quantize
 
 __all__ = [
@@ -1065,6 +1066,13 @@ class SparseOptimizer:
         program: the cache math then runs in a fully-replicated
         ``shard_map`` (see :func:`_replicated_shard_map`) while the big
         table/slot gathers stay outside on the sharded arrays."""
+        if counters.enabled():
+            # pre-admission route: how many of this step's unique rows the
+            # cache already held.  Gather-only on replicated cache arrays,
+            # and traced ONLY under an active collector (byte-identity).
+            _, hit = cache_route(cache, jnp.where(valid, uids, -1))
+            counters.emit("cache_hit_rows", (hit & valid).sum())
+            counters.emit("cache_miss_rows", (valid & ~hit).sum())
         # the ONLY touches of the big arrays: plain per-uid row gathers,
         # which GSPMD partitions correctly on sharded tables
         gid = jnp.minimum(jnp.where(valid, uids, 0), table.shape[0] - 1)
@@ -1159,6 +1167,7 @@ class SparseOptimizer:
             ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
             capacity=capacity, vocab=table.shape[0],
             max_distinct=max_distinct)
+        counters.emit("unique_rows", lambda: valid.sum())
         return self.cache_update_unique(cache, table, slots, uids, g, valid,
                                         step=step, sr_key=sr_key, mesh=mesh)
 
@@ -1174,6 +1183,8 @@ class SparseOptimizer:
         cids, cslot = cache["ids"], cache["slot"]
         oob = jnp.asarray(_CACHE_OOB, jnp.int32)
         dirty_dir = jnp.take(cache["dirty"], cslot) & (cids < oob)
+        counters.emit("cache_flushed_rows", lambda: dirty_dir.sum())
+        counters.emit("cache_resident_rows", lambda: (cids < oob).sum())
         tgt = jnp.where(dirty_dir, cids, table.shape[0])
         table = table.at[tgt].set(
             jnp.take(cache["rows"], cslot, axis=0), mode="drop")

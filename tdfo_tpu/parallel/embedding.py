@@ -839,6 +839,79 @@ class ShardedEmbeddingCollection:
             total = total + cnt
         return total
 
+    def a2a_fill_stats(self, tables: Mapping[str, jax.Array],
+                       features: Mapping[str, jax.Array]):
+        """Send-bucket utilisation of the ``alltoall`` lookup program for
+        this batch: ``(fill, dropped)`` where ``fill`` is the f32 fraction
+        of total bucket capacity actually carrying ids and ``dropped`` the
+        int32 overflow count (:meth:`a2a_overflow` semantics).  The
+        telemetry companion of the capacity knob: a LOW fill says the
+        factor can shrink (smaller a2a payloads), overflow > 0 says it
+        already dropped ids.  Same cost shape as ``a2a_overflow`` — owner
+        bucketing arithmetic + one psum per group, no table reads.  The
+        bodies stay counter-free (``core/mesh.shard_map`` suppresses
+        emission); callers emit the returned values."""
+        if self.mesh is None or self.n_shards <= 1:
+            return jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)
+        m = self.n_shards
+        axis = self.axis
+        cf = self.a2a_capacity_factor
+        sent = jnp.zeros((), jnp.int32)
+        cap_total = jnp.zeros((), jnp.int32)
+        dropped = jnp.zeros((), jnp.int32)
+
+        def bucket_stats(owner, n):
+            cap = _a2a_bucket_cap(n, m, cf)
+            counts = jnp.sum(owner[None, :] == jnp.arange(m)[:, None], axis=1)
+            s = jnp.sum(jnp.minimum(counts, cap))
+            d = jnp.sum(jnp.maximum(counts - cap, 0))
+            return (jax.lax.psum(s.astype(jnp.int32), axis),
+                    jax.lax.psum(jnp.asarray(m * cap, jnp.int32), axis),
+                    jax.lax.psum(d.astype(jnp.int32), axis))
+
+        if self.grouped_a2a:
+            eligible = {
+                f: ids for f, ids in features.items()
+                if (self._feature_to_table.get(f, f) not in self.hot_ids
+                    and self.resolve(f)[1].sharding in ("row", "table"))
+            }
+            for g in self._grouped_plan(tuple(eligible)):
+                flats = self._group_flats(g, eligible)
+                feat_rps = self._group_feat_rps(g)
+
+                def local(*id_parts, _feat_rps=feat_rps):
+                    owner, _ = self._owner_virt(id_parts, _feat_rps)
+                    return bucket_stats(owner, owner.shape[0])
+
+                s, c, d = shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=tuple(P(axis) for _ in flats),
+                    out_specs=(P(), P(), P()), check_vma=False,
+                )(*flats)
+                sent, cap_total, dropped = sent + s, cap_total + c, dropped + d
+        else:
+            for feat, ids in features.items():
+                tname, spec, offset = self.resolve(feat)
+                if spec.sharding not in ("row", "table"):
+                    continue
+                rows_per_shard = self._rows_per_shard(tables[tname], spec)
+
+                def local(ids_local, rows_per_shard=rows_per_shard,
+                          offset=offset):
+                    flat = ids_local.reshape(-1) + offset
+                    owner = jnp.clip(flat // rows_per_shard, 0, m - 1)
+                    return bucket_stats(owner, flat.shape[0])
+
+                s, c, d = shard_map(
+                    local, mesh=self.mesh,
+                    in_specs=P(axis, *([None] * (ids.ndim - 1))),
+                    out_specs=(P(), P(), P()), check_vma=False,
+                )(ids)
+                sent, cap_total, dropped = sent + s, cap_total + c, dropped + d
+        fill = sent.astype(jnp.float32) / jnp.maximum(
+            cap_total.astype(jnp.float32), 1.0)
+        return fill, dropped
+
     def lookup(
         self,
         tables: Mapping[str, jax.Array],

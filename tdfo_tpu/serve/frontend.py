@@ -49,6 +49,7 @@ class MicroBatcher:
         batch_deadline_ms: float,
         logger=None,
         clock: Callable[[], float] = time.monotonic,
+        program_cache_size: Callable[[], int] | None = None,
     ):
         buckets = tuple(buckets)
         if not buckets or list(buckets) != sorted(set(buckets)):
@@ -62,6 +63,10 @@ class MicroBatcher:
         self._deadline_s = float(batch_deadline_ms) / 1000.0
         self._logger = logger
         self._clock = clock
+        # bounded-jit-cache invariant as a RUNTIME assertion (not just the
+        # test pin): when the scorer exposes its compiled-program count,
+        # every ship verifies it stays <= len(buckets)
+        self._cache_size = program_cache_size
         self._pending: list[tuple[Any, dict[str, np.ndarray], int, float]] = []
         self._pending_rows = 0
         self.results: dict[Any, np.ndarray] = {}
@@ -130,7 +135,18 @@ class MicroBatcher:
                               [(0, 0)] * (col.ndim - 1))
         scores = np.asarray(self._score(batch))[:rows]
         self.shipped.append((rows, padded))
+        if self._cache_size is not None:
+            n_progs = self._cache_size()
+            if n_progs > len(self._buckets):
+                raise RuntimeError(
+                    f"bounded-jit-cache invariant violated: the scorer holds "
+                    f"{n_progs} compiled programs for {len(self._buckets)} "
+                    f"buckets — a non-bucket batch shape reached score_fn")
         done = self._clock()
+        # saturation observability: requests still waiting after this ship,
+        # and how much of the padded program the batch actually used
+        depth = len(self._pending)
+        fill = rows / padded
         off = 0
         for rid, _, n, t0 in take:
             self.results[rid] = scores[off:off + n]
@@ -140,6 +156,7 @@ class MicroBatcher:
             if self._logger is not None:
                 self._logger.log(event="serve_request", request=str(rid),
                                  rows=n, batch_rows=rows, padded=padded,
+                                 queue_depth=depth, batch_fill=fill,
                                  latency_ms=latency_ms)
 
     # -------------------------------------------------------------- stats
@@ -225,7 +242,8 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
     t0 = time.monotonic()
     mb = MicroBatcher(
         scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
-        batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger)
+        batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
+        program_cache_size=scorer.score_cache_size)
     mb.run(requests)
     wall = time.monotonic() - t0
     stats = mb.stats()

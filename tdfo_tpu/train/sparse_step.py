@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from tdfo_tpu.obs import counters as obs_counters
 from tdfo_tpu.ops.quant import sr_key as _make_sr_key
 from tdfo_tpu.ops.sparse import SparseOptimizer, cache_lookup_rows, dedupe_ids
 from tdfo_tpu.ops.sparse import cache_overlay_rows
@@ -337,6 +338,8 @@ def make_sparse_train_step(
                 d = coll.array_embedding_dim(tname)
                 fat = table.ndim == 3
                 all_ids, sizes, bound = _concat_ids(feats, cold_ids)
+                obs_counters.emit(f"emb/{tname}/touched_ids",
+                                  lambda a=all_ids: (a >= 0).sum())
                 total = all_ids.shape[0]
                 # +1 slack: negative (padding) ids dedupe to ONE sentinel
                 # slot beyond the real-id bound; without it the expand would
@@ -375,6 +378,8 @@ def make_sparse_train_step(
                             rowlines[:, s * lay.w: s * lay.w + d], rows)
                     dedup_ctx[tname] = ("routed", ulines, seg, row_lidx,
                                         row_slot, lines)
+                    obs_counters.emit(f"emb/{tname}/unique_lines",
+                                      lambda u=ulines: (u < oob).sum())
                 else:
                     uids, seg, valid = dedupe_ids(
                         all_ids.astype(jnp.int32), capacity=cap,
@@ -393,6 +398,8 @@ def make_sparse_train_step(
                             jnp.where(valid, uids, 0),
                             rows, mesh=coll.mesh)
                     dedup_ctx[tname] = ("rows", uids, seg, valid)
+                    obs_counters.emit(f"emb/{tname}/unique_rows",
+                                      lambda v=valid: v.sum())
                 off = 0
                 # dequantize after the compact gather (identity for f32):
                 # the model interface is f32 whatever the storage dtype
@@ -414,6 +421,15 @@ def make_sparse_train_step(
         aux = None
         if with_aux:
             loss, aux = loss
+        if obs_counters.enabled():
+            # global norms over the dense half and the gathered-vector
+            # grads (the table-side signal without a [V, D] reduction);
+            # param_norm walks the full tables — one HBM pass, priced into
+            # telemetry.counters = true only
+            obs_counters.emit("grad_norm",
+                              optax.global_norm((g_dense, g_embs)))
+            obs_counters.emit("param_norm", optax.global_norm(
+                (state.dense_params, state.tables)))
 
         # dense half: optax
         updates, new_opt_state = state.tx.update(g_dense, state.opt_state, state.dense_params)
@@ -492,14 +508,15 @@ def make_sparse_train_step(
                     ck = CACHE_PREFIX + tname
                     u_r, g_r, v_r = _pin_replicated(
                         coll.mesh, (uids, g_u, valid))
-                    new_cache, new_slots[tname] = (
-                        state.sparse_opt.cache_update_unique(
-                            _pin_replicated(coll.mesh, state.slots[ck]),
-                            state.tables[tname],
-                            state.slots[tname], u_r, g_r, v_r,
-                            step=state.step, sr_key=_sr_key(tname),
-                            mesh=coll.mesh,
-                        ))
+                    with obs_counters.scope(f"emb/{tname}/"):
+                        new_cache, new_slots[tname] = (
+                            state.sparse_opt.cache_update_unique(
+                                _pin_replicated(coll.mesh, state.slots[ck]),
+                                state.tables[tname],
+                                state.slots[tname], u_r, g_r, v_r,
+                                step=state.step, sr_key=_sr_key(tname),
+                                mesh=coll.mesh,
+                            ))
                     new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
                     continue
                 new_tables[tname], new_slots[tname] = state.sparse_opt.update_unique(
@@ -508,6 +525,8 @@ def make_sparse_train_step(
                 )
                 continue
             all_ids, _, bound = _concat_ids(feats, cold_ids)
+            obs_counters.emit(f"emb/{tname}/touched_ids",
+                              lambda a=all_ids: (a >= 0).sum())
             # dedupe capacity = the proven bound when it is tighter than the
             # id count: scatter cost scales with SLOTS, so stacked many-table
             # arrays (e.g. DLRM-Criteo, where small tables are fully covered
@@ -521,14 +540,15 @@ def make_sparse_train_step(
                 ck = CACHE_PREFIX + tname
                 i_r, g_r = _pin_replicated(
                     coll.mesh, (all_ids, all_grads))
-                new_cache, new_slots[tname] = (
-                    state.sparse_opt.cache_update(
-                        _pin_replicated(coll.mesh, state.slots[ck]),
-                        state.tables[tname],
-                        state.slots[tname], i_r, g_r,
-                        step=state.step, capacity=md, max_distinct=md,
-                        sr_key=_sr_key(tname), mesh=coll.mesh,
-                    ))
+                with obs_counters.scope(f"emb/{tname}/"):
+                    new_cache, new_slots[tname] = (
+                        state.sparse_opt.cache_update(
+                            _pin_replicated(coll.mesh, state.slots[ck]),
+                            state.tables[tname],
+                            state.slots[tname], i_r, g_r,
+                            step=state.step, capacity=md, max_distinct=md,
+                            sr_key=_sr_key(tname), mesh=coll.mesh,
+                        ))
                 new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
                 continue
             # sharding-aware routing: fused row-sharded tables update inside
@@ -549,6 +569,8 @@ def make_sparse_train_step(
             feats = hot_by_table[tname]
             hp_all = jnp.concatenate(
                 [hot_pos[f].reshape(-1) for f in feats])
+            obs_counters.emit(f"emb/{tname}/hot_ids",
+                              lambda h=hp_all: (h >= 0).sum())
             g_all = jnp.concatenate([
                 g_embs[f].reshape(-1, g_embs[f].shape[-1]) for f in feats
             ])
@@ -576,7 +598,7 @@ def make_sparse_train_step(
 
 
 def make_cache_flush_fn(*, donate: bool = True, jit: bool = True,
-                        mesh=None):
+                        mesh=None, counters: bool = False):
     """Build the coalesced write-back program of the update cache:
     ``flush(state) -> (state, overflow)``.
 
@@ -592,9 +614,14 @@ def make_cache_flush_fn(*, donate: bool = True, jit: bool = True,
     entry — the bit-exactness contract is broken past that point.  A state
     without cache entries flushes to itself (empty overflow dict).  Pass
     the collection's ``mesh`` so the cache stays pinned replicated inside
-    the jitted program (see ``_pin_replicated``)."""
+    the jitted program (see ``_pin_replicated``).
 
-    def flush(state: SparseTrainState):
+    ``counters=True`` (``telemetry.counters``) collects the flush's
+    in-graph diagnostics (``emb/<array>/cache_flushed_rows`` and resident
+    counts, ``tdfo_tpu/obs/counters.py``) and returns ``(state, overflow,
+    counters_dict)``; the default signature and graph are untouched."""
+
+    def _body(state: SparseTrainState):
         new_tables = dict(state.tables)
         new_slots = dict(state.slots)
         overflow = {}
@@ -602,9 +629,10 @@ def make_cache_flush_fn(*, donate: bool = True, jit: bool = True,
             if not key.startswith(CACHE_PREFIX):
                 continue
             aname = key[len(CACHE_PREFIX):]
-            cache, table, slots, over = state.sparse_opt.cache_flush(
-                _pin_replicated(mesh, state.slots[key]),
-                state.tables[aname], state.slots[aname])
+            with obs_counters.scope(f"emb/{aname}/"):
+                cache, table, slots, over = state.sparse_opt.cache_flush(
+                    _pin_replicated(mesh, state.slots[key]),
+                    state.tables[aname], state.slots[aname])
             new_tables[aname] = table
             new_slots[aname] = slots
             new_slots[key] = _pin_replicated(mesh, cache)
@@ -618,6 +646,14 @@ def make_cache_flush_fn(*, donate: bool = True, jit: bool = True,
             tx=state.tx,
             sparse_opt=state.sparse_opt,
         ), overflow
+
+    if counters:
+        def flush(state: SparseTrainState):
+            with obs_counters.collect() as ctrs:
+                new_state, overflow = _body(state)
+            return new_state, overflow, dict(ctrs)
+    else:
+        flush = _body
 
     if not jit:
         return flush
@@ -728,6 +764,15 @@ def make_pipelined_sparse_train_step(
         aux = None
         if with_aux:
             loss, aux = loss
+        if obs_counters.enabled():
+            # global norms over the dense half and the gathered-vector
+            # grads (the table-side signal without a [V, D] reduction);
+            # param_norm walks the full tables — one HBM pass, priced into
+            # telemetry.counters = true only
+            obs_counters.emit("grad_norm",
+                              optax.global_norm((g_dense, g_embs)))
+            obs_counters.emit("param_norm", optax.global_norm(
+                (state.dense_params, state.tables)))
 
         updates, new_opt_state = state.tx.update(
             g_dense, state.opt_state, state.dense_params)

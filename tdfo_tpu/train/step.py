@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdfo_tpu.core.mesh import DATA_AXIS
 from tdfo_tpu.core.precision import scale_loss, unscale_grads
+from tdfo_tpu.obs import counters as obs_counters
 from tdfo_tpu.train.state import TrainState
 
 __all__ = ["bce_with_logits_loss", "make_train_step", "make_eval_step", "make_multi_step"]
@@ -75,6 +76,9 @@ def make_train_step(
             state.params
         )
         grads, finite = unscale_grads(grads, state.loss_scale)
+        if obs_counters.enabled():
+            obs_counters.emit("grad_norm", optax.global_norm(grads))
+            obs_counters.emit("param_norm", optax.global_norm(state.params))
 
         new_state = state.apply_gradients(grads)
         if state.loss_scale is not None:

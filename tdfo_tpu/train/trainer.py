@@ -46,6 +46,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tdfo_tpu.core.config import Config
 from tdfo_tpu.core.mesh import make_mesh
+from tdfo_tpu.obs import counters as obs_counters
+from tdfo_tpu.obs import events as obs_events
 from tdfo_tpu.data.loader import (
     MapStream,
     ParquetStream,
@@ -70,6 +72,9 @@ class MetricLogger:
         self._f = None
         self._tb = None
         self._n = 0
+        # telemetry norm scalars accumulate here and flush as ONE histogram
+        # summary per tag at close() (run-wide distribution view)
+        self._hist_buf: dict[str, list[float]] = {}
         if log_dir is not None and jax.process_index() == 0:
             Path(log_dir).mkdir(parents=True, exist_ok=True)
             self._f = open(Path(log_dir) / "metrics.jsonl", "a")
@@ -82,6 +87,14 @@ class MetricLogger:
                 self._tb = TBScalarWriter(log_dir)
 
     def log(self, **record: Any) -> None:
+        # numpy scalars (device fetches, np.float32 arithmetic) are not JSON
+        # serialisable and dodge the float-format branch below — coerce at
+        # the door so callers can pass fetched values straight through
+        record = {
+            k: (v.item() if isinstance(v, np.generic)
+                or (isinstance(v, np.ndarray) and v.ndim == 0) else v)
+            for k, v in record.items()
+        }
         record.setdefault("time", time.time())
         if jax.process_index() == 0:
             msg = ", ".join(
@@ -105,6 +118,9 @@ class MetricLogger:
                     "global_step", record.get("epoch", self._n))
                 self._tb.scalars(int(step), scalars,
                                  wall_time=record["time"])
+                for k in ("grad_norm", "param_norm"):
+                    if k in scalars:
+                        self._hist_buf.setdefault(k, []).append(scalars[k])
             self._n += 1
 
     def close(self) -> None:
@@ -114,6 +130,9 @@ class MetricLogger:
             self._f.close()
             self._f = None
         if self._tb is not None:
+            for tag, vals in self._hist_buf.items():
+                self._tb.histogram(self._n, f"{tag}_dist", vals)
+            self._hist_buf = {}
             self._tb.close()
             self._tb = None
 
@@ -195,7 +214,8 @@ def _make_ctr_eval_accum(logits_fn: Callable):
     return accum
 
 
-def _wrap_auc_step(inner, *, donate_state: bool = True):
+def _wrap_auc_step(inner, *, donate_state: bool = True,
+                   counters: bool = False):
     """Fuse the train-side streaming-AUC fold INTO the step's single jitted
     program: ``(state, batch, acc) -> (state, loss, acc)``.
 
@@ -204,67 +224,146 @@ def _wrap_auc_step(inner, *, donate_state: bool = True):
     deadlocked the cross-process dispatch rendezvous (two global programs
     racing for the mesh in different orders on different hosts).  ``inner``
     is an unjitted ``with_aux`` step returning ``(state, (loss, logits))``.
+
+    ``counters=True`` opens a telemetry collector around the trace and
+    appends the gathered dict as an extra return; ``False`` keeps the
+    construction — and the jaxpr — exactly as without telemetry (the lazy
+    ``emit`` thunks below add zero equations when no collector is open).
     """
 
-    def step(state, batch, acc: AUC):
+    def _step(state, batch, acc: AUC):
         state, (loss, logits) = inner(state, batch)
         # mixed-precision overflow steps can emit non-finite logits; a
         # NaN->int32 histogram-bin cast is backend-defined, so weight those
         # samples out of the streaming AUC instead of folding garbage in
         ok = jnp.isfinite(logits)
+        obs_counters.emit("nonfinite_logits", lambda: (~ok).sum())
         acc = acc.update(batch["label"].astype(jnp.float32),
                          jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
                          ok.astype(jnp.float32))
         return state, loss, acc
 
+    if counters:
+        def step(state, batch, acc: AUC):
+            with obs_counters.collect() as c:
+                out = _step(state, batch, acc)
+            return (*out, dict(c))
+    else:
+        step = _step
+
     return jax.jit(step, donate_argnums=(0,) if donate_state else ())
 
 
-def _wrap_auc_multi_step(inner, *, donate_state: bool = True):
+def _wrap_auc_multi_step(inner, *, donate_state: bool = True,
+                         counters: bool = False):
     """steps_per_execution twin of :func:`_wrap_auc_step`: scan the unjitted
-    step over a stacked chunk, folding AUC in the scan carry."""
+    step over a stacked chunk, folding AUC in the scan carry.  With
+    ``counters`` the collector opens INSIDE the scan body (a collector
+    opened outside would capture body tracers and leak them through the
+    scan boundary); counter dicts stack as scan outputs and the chunk
+    reports the final step's values."""
 
-    def multi(state, stack, acc: AUC):
-        def body(carry, batch):
-            st, a = carry
-            st, (loss, logits) = inner(st, batch)
-            ok = jnp.isfinite(logits)  # see _wrap_auc_step
-            a = a.update(batch["label"].astype(jnp.float32),
-                         jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
-                         ok.astype(jnp.float32))
-            return (st, a), loss
+    def _body(carry, batch):
+        st, a = carry
+        st, (loss, logits) = inner(st, batch)
+        ok = jnp.isfinite(logits)  # see _wrap_auc_step
+        obs_counters.emit("nonfinite_logits", lambda: (~ok).sum())
+        a = a.update(batch["label"].astype(jnp.float32),
+                     jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
+                     ok.astype(jnp.float32))
+        return (st, a), loss
 
-        (state, acc), losses = jax.lax.scan(body, (state, acc), stack)
-        return state, losses.mean(), acc
+    if counters:
+        def multi(state, stack, acc: AUC):
+            def body(carry, batch):
+                with obs_counters.collect() as c:
+                    carry, loss = _body(carry, batch)
+                return carry, (loss, dict(c))
+
+            (state, acc), (losses, cs) = jax.lax.scan(body, (state, acc), stack)
+            return (state, losses.mean(), acc,
+                    jax.tree.map(lambda x: x[-1], cs))
+    else:
+        def multi(state, stack, acc: AUC):
+            (state, acc), losses = jax.lax.scan(_body, (state, acc), stack)
+            return state, losses.mean(), acc
 
     return jax.jit(multi, donate_argnums=(0,) if donate_state else ())
 
 
-def _wrap_auc_pipelined(pipe, *, donate_state: bool = False):
+def _wrap_auc_pipelined(pipe, *, donate_state: bool = False,
+                        counters: bool = False):
     """Pipelined twin of :func:`_wrap_auc_step`: the step trains the CARRIED
     batch, so the AUC fold reads the carry's labels — folding the incoming
     batch's labels would pair them with the previous batch's logits.
-    Returns jitted ``(prime, step, flush)``."""
+    Returns jitted ``(prime, step, flush)``; ``counters`` appends the
+    telemetry dict to step/flush returns (see :func:`_wrap_auc_step`)."""
 
     def _fold(acc: AUC, labels, logits):
         ok = jnp.isfinite(logits)  # see _wrap_auc_step
+        obs_counters.emit("nonfinite_logits", lambda: (~ok).sum())
         return acc.update(labels.astype(jnp.float32),
                           jax.nn.sigmoid(jnp.where(ok, logits, 0.0)),
                           ok.astype(jnp.float32))
 
-    def step(state, batch, carry, acc: AUC):
+    def _step(state, batch, carry, acc: AUC):
         labels = carry[0]["label"]
         state, (loss, logits), carry = pipe.step(state, batch, carry)
         return state, loss, carry, _fold(acc, labels, logits)
 
-    def flush(state, carry, acc: AUC):
+    def _flush(state, carry, acc: AUC):
         labels = carry[0]["label"]
         state, (loss, logits) = pipe.flush(state, carry)
         return state, loss, _fold(acc, labels, logits)
 
+    if counters:
+        def step(state, batch, carry, acc: AUC):
+            with obs_counters.collect() as c:
+                out = _step(state, batch, carry, acc)
+            return (*out, dict(c))
+
+        def flush(state, carry, acc: AUC):
+            with obs_counters.collect() as c:
+                out = _flush(state, carry, acc)
+            return (*out, dict(c))
+    else:
+        step, flush = _step, _flush
+
     d = (0,) if donate_state else ()
     return (jax.jit(pipe.prime), jax.jit(step, donate_argnums=d),
             jax.jit(flush, donate_argnums=d))
+
+
+def _wrap_counters_step(fn, *, donate_state: bool = False):
+    """Counter-collecting jit wrapper for steps WITHOUT an AUC fold
+    (bert4rec): append the telemetry dict to ``fn``'s return tuple.  Only
+    built when ``telemetry.counters`` is on — the off path keeps the
+    original (wrapper-free) construction, so its jaxpr cannot drift."""
+
+    def wrapped(*args):
+        with obs_counters.collect() as c:
+            out = fn(*args)
+        out = out if isinstance(out, tuple) else (out,)
+        return (*out, dict(c))
+
+    return jax.jit(wrapped, donate_argnums=(0,) if donate_state else ())
+
+
+def _wrap_counters_multi_step(step_fn, *, donate_state: bool = False):
+    """steps_per_execution twin of :func:`_wrap_counters_step` (the
+    counter-aware variant of ``step.make_multi_step``): collect inside the
+    scan body, stack as scan outputs, report the final step's values."""
+
+    def multi(state, stack, *rest):
+        def body(st, batch):
+            with obs_counters.collect() as c:
+                st, loss = step_fn(st, batch, *rest)
+            return st, (loss, dict(c))
+
+        state, (losses, cs) = jax.lax.scan(body, state, stack)
+        return state, losses.mean(), jax.tree.map(lambda x: x[-1], cs)
+
+    return jax.jit(multi, donate_argnums=(0,) if donate_state else ())
 
 
 def _commit_replicated(state, mesh):
@@ -343,6 +442,27 @@ class Trainer:
         # THIS config — the kill marker lives in checkpoint_dir so "restart
         # the same command" converges instead of crash-looping
         _faults.configure(config.faults, config.checkpoint_dir or None)
+        # [telemetry]: counters ride the step's return pytree and are fetched
+        # at the existing log boundary (no extra host syncs); compile/memory
+        # events stream to events.jsonl; the stall watchdog heartbeats to
+        # heartbeat.jsonl from a daemon thread while fit() runs
+        tele = config.telemetry
+        self._counters_on = tele.counters
+        self._flush_ctrs: dict = {}  # latest cache-flush counter fetch
+        self._a2a_fill = None  # alltoall bucket-utilisation probe (jitted)
+        self._watchdog = None
+        if (tele.events or tele.stall_timeout_s > 0) and not out_dir:
+            raise ValueError(
+                "telemetry.events / telemetry.stall_timeout_s need a "
+                "checkpoint_dir (or log_dir) to write events.jsonl / "
+                "heartbeat.jsonl")
+        if tele.events and jax.process_index() == 0:
+            obs_events.configure(Path(out_dir) / "events.jsonl")
+        if tele.stall_timeout_s > 0 and jax.process_index() == 0:
+            from tdfo_tpu.obs.watchdog import StallWatchdog
+
+            self._watchdog = StallWatchdog(
+                Path(out_dir) / "heartbeat.jsonl", tele.stall_timeout_s)
         if config.checkpoint_dir:
             from tdfo_tpu.train.checkpoint import CheckpointManager
 
@@ -440,9 +560,10 @@ class Trainer:
             self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
         inner = make_train_step(mesh=self.mesh, jit=False, with_aux=True)
         if cfg.steps_per_execution > 1:
-            self.train_step = _wrap_auc_multi_step(inner)
+            self.train_step = _wrap_auc_multi_step(
+                inner, counters=self._counters_on)
         else:
-            self.train_step = _wrap_auc_step(inner)
+            self.train_step = _wrap_auc_step(inner, counters=self._counters_on)
         self._train_auc_enabled = True
         self.eval_step = make_eval_step(mesh=self.mesh)
         self._eval_schema = _ctr_eval_schema(*_ctr_columns(cfg))
@@ -592,7 +713,8 @@ class Trainer:
             if caches:
                 self.state = dataclasses.replace(
                     self.state, slots={**self.state.slots, **caches})
-                self._cache_flush = make_cache_flush_fn(mesh=coll.mesh)
+                self._cache_flush = make_cache_flush_fn(
+                    mesh=coll.mesh, counters=self._counters_on)
                 self._flush_every = cfg.embeddings.flush_every
         if cfg.train.pipeline_overlap:
             # TrainPipelineSparseDist parity: batch N+1's input-dist issues
@@ -612,7 +734,8 @@ class Trainer:
             )
             self._pipelined = True
             self._prime_step, self.train_step, self._flush_step = (
-                _wrap_auc_pipelined(pipe, donate_state=False))
+                _wrap_auc_pipelined(pipe, donate_state=False,
+                                    counters=self._counters_on))
         else:
             inner = make_sparse_train_step(
                 coll, ctr_sparse_forward(backbone, with_logits=True),
@@ -620,9 +743,11 @@ class Trainer:
                 dedup_lookup=cfg.dedup_lookup,
             )
             if cfg.steps_per_execution > 1:
-                self.train_step = _wrap_auc_multi_step(inner, donate_state=False)
+                self.train_step = _wrap_auc_multi_step(
+                    inner, donate_state=False, counters=self._counters_on)
             else:
-                self.train_step = _wrap_auc_step(inner, donate_state=False)
+                self.train_step = _wrap_auc_step(
+                    inner, donate_state=False, counters=self._counters_on)
         self._train_auc_enabled = True
         self.eval_step = make_ctr_sparse_eval_step(coll, backbone, mode=cfg.lookup_mode)
         self._eval_schema = _ctr_eval_schema(cat_cols, cont_cols)
@@ -634,6 +759,12 @@ class Trainer:
             # (steps_per_execution > 1 logs stacked chunks whose leading dim
             # is steps, not batch — skipped there)
             self._a2a_overflow = jax.jit(lambda st, bt: coll.a2a_overflow(
+                st.tables, {f: bt[f] for f in features}))
+        if (mode == "alltoall" and self._counters_on
+                and cfg.steps_per_execution == 1):
+            # telemetry companion of the capacity knob: bucket fill fraction
+            # + dropped ids, logged alongside the step counters
+            self._a2a_fill = jax.jit(lambda st, bt: coll.a2a_fill_stats(
                 st.tables, {f: bt[f] for f in features}))
 
         def sparse_logits(state, batch):
@@ -728,23 +859,43 @@ class Trainer:
                 raise ValueError(
                     "dedup_lookup (gspmd-only) does not compose with "
                     "train.pipeline_overlap")
-            pipe = make_pipelined_sparse_train_step(
-                self.coll, bert4rec_sparse_forward(self.backbone),
-                donate=False, batch_transform=transform,
-            )
-            self._pipelined = True
-            self._prime_step = pipe.prime
-            self.train_step = pipe.step
-            self._flush_step = pipe.flush
-        elif cfg.steps_per_execution > 1:
-            self.train_step = make_multi_step(
-                make_sparse_train_step(
+            if self._counters_on:
+                # counter collection needs the UNJITTED prime/step/flush (a
+                # collector cannot reach across an inner jit boundary); the
+                # off path below keeps the original construction untouched
+                pipe = make_pipelined_sparse_train_step(
                     self.coll, bert4rec_sparse_forward(self.backbone),
-                    mode=cfg.lookup_mode, jit=False, batch_transform=transform,
-                    dedup_lookup=cfg.dedup_lookup,
-                ),
-                donate_state=False,
+                    jit=False, batch_transform=transform,
+                )
+                self._pipelined = True
+                self._prime_step = jax.jit(pipe.prime)
+                self.train_step = _wrap_counters_step(pipe.step)
+                self._flush_step = _wrap_counters_step(pipe.flush)
+            else:
+                pipe = make_pipelined_sparse_train_step(
+                    self.coll, bert4rec_sparse_forward(self.backbone),
+                    donate=False, batch_transform=transform,
+                )
+                self._pipelined = True
+                self._prime_step = pipe.prime
+                self.train_step = pipe.step
+                self._flush_step = pipe.flush
+        elif cfg.steps_per_execution > 1:
+            inner = make_sparse_train_step(
+                self.coll, bert4rec_sparse_forward(self.backbone),
+                mode=cfg.lookup_mode, jit=False, batch_transform=transform,
+                dedup_lookup=cfg.dedup_lookup,
             )
+            if self._counters_on:
+                self.train_step = _wrap_counters_multi_step(inner)
+            else:
+                self.train_step = make_multi_step(inner, donate_state=False)
+        elif self._counters_on:
+            self.train_step = _wrap_counters_step(make_sparse_train_step(
+                self.coll, bert4rec_sparse_forward(self.backbone),
+                mode=cfg.lookup_mode, jit=False, batch_transform=transform,
+                dedup_lookup=cfg.dedup_lookup,
+            ))
         else:
             self.train_step = make_sparse_train_step(
                 self.coll, bert4rec_sparse_forward(self.backbone),
@@ -759,6 +910,11 @@ class Trainer:
             # -> zero vectors) in the JSONL log
             seq_coll = self.coll
             self._a2a_overflow = jax.jit(lambda st, bt: seq_coll.a2a_overflow(
+                st.tables, {"item": bt["item"]}))
+        if (cfg.lookup_mode == "alltoall" and self._counters_on
+                and not cfg.jagged and cfg.steps_per_execution == 1):
+            fill_coll = self.coll
+            self._a2a_fill = jax.jit(lambda st, bt: fill_coll.a2a_fill_stats(
                 st.tables, {"item": bt["item"]}))
         self._stream_cls = ParquetStream  # seq ETL writes parquet only
         self._train_pattern = str(Path("parquet_bert4rec") / cfg.train_data)
@@ -949,8 +1105,12 @@ class Trainer:
         """
         cfg = self.config
         inj = _faults.active()
-        t0 = time.perf_counter()
+        # monotonic: host-loop wall time (throughput), the one sanctioned
+        # wall-clock differencing outside bench.chain_time — time.time /
+        # perf_counter differencing is rejected by tests/test_quality.py
+        t0 = time.monotonic()
         n_steps = start_step
+        step_ctrs: dict = {}  # latest step's device counter pytree
         # update-cache write-back schedule: the periodic flush runs async
         # (overflow counters queue like the pending losses and are verified
         # at the same cadence — no extra host sync); checkpoint/eval/epoch
@@ -1043,26 +1203,33 @@ class Trainer:
                     continue
                 if self._pipelined:
                     if cfg.model == "bert4rec":
-                        self.state, loss, carry = self.train_step(
+                        out = self.train_step(
                             self.state, batch, carry, self._dropout_rng)
+                        self.state, loss, carry = out[:3]
                     else:
-                        self.state, loss, carry, train_auc = self.train_step(
+                        out = self.train_step(
                             self.state, batch, carry, train_auc)
+                        self.state, loss, carry, train_auc = out[:4]
                 elif cfg.model == "bert4rec":
-                    self.state, loss = self.train_step(self.state, batch, self._dropout_rng)
+                    out = self.train_step(self.state, batch, self._dropout_rng)
+                    self.state, loss = out[:2]
                 else:
-                    self.state, loss, train_auc = self.train_step(
-                        self.state, batch, train_auc
-                    )
+                    out = self.train_step(self.state, batch, train_auc)
+                    self.state, loss, train_auc = out[:3]
+                if self._counters_on:
+                    # DEVICE dict (the step's extra return) — floats are
+                    # pulled at the log boundary with the train_loss fetch
+                    step_ctrs = out[-1]
                 n_steps += k
                 gstep = self._logged_steps + n_steps
+                if self._watchdog is not None:
+                    self._watchdog.beat(gstep)
                 pending.append((loss, k, gstep))
                 pending_steps += k
                 if next_flush is not None and n_steps >= next_flush:
                     # coalesced cache write-back: the ONLY big-table scatter
                     # in the cadence — one per flush_every steps
-                    self.state, over = self._cache_flush(self.state)
-                    pending_over.append(over)
+                    pending_over.append(self._run_cache_flush())
                     next_flush = (n_steps // flush_n + 1) * flush_n
                 if pending_steps >= flush_every:
                     flush_checks()
@@ -1089,6 +1256,7 @@ class Trainer:
                     )
                     next_ckpt = (n_steps // ckpt_n + 1) * ckpt_n
                 if inj is not None:
+                    inj.maybe_stall(gstep)  # host-side sleep (watchdog test)
                     inj.maybe_kill(gstep)  # after the save: ckpt is durable
                 if n_steps >= next_log:
                     rec = dict(epoch=epoch, step=n_steps, train_loss=float(loss))
@@ -1097,10 +1265,30 @@ class Trainer:
                         # (zero vectors under skew — watch for quality decay)
                         rec["a2a_overflow_ids"] = int(
                             self._a2a_overflow(self.state, batch))
+                    if self._counters_on:
+                        # ONE host fetch of the latest step's counter pytree
+                        # — the same boundary the train_loss float() above
+                        # already syncs on, so the cadence is unchanged
+                        for ck, cv in {**step_ctrs, **self._flush_ctrs}.items():
+                            rec[ck] = float(cv)
+                        for ck in [c for c in rec
+                                   if c.endswith("cache_hit_rows")]:
+                            base = ck[: -len("hit_rows")]
+                            tot = rec[ck] + rec.get(base + "miss_rows", 0.0)
+                            if tot:
+                                rec[base + "hit_rate"] = rec[ck] / tot
+                        if self._a2a_fill is not None:
+                            fill, dropped = self._a2a_fill(self.state, batch)
+                            rec["a2a_fill"] = float(fill)
+                            rec["a2a_dropped_ids"] = int(dropped)
                     # TB charts need a run-global x (per-epoch `step` resets,
                     # which would fold multi-epoch curves back on themselves)
                     rec["global_step"] = gstep
                     self.logger.log(**rec)
+                    # device-memory watermark at the log cadence (no-op on
+                    # backends without memory_stats, e.g. spoofed CPU)
+                    if obs_events.active():
+                        obs_events.memory_snapshot()
                     # chunked counting can jump n_steps past several
                     # intervals; advance past n_steps so each interval logs
                     # at most once
@@ -1110,11 +1298,11 @@ class Trainer:
                 # (flush is prime's twin — together they shift every batch's
                 # training one call later without changing its math)
                 if cfg.model == "bert4rec":
-                    self.state, loss = self._flush_step(
-                        self.state, carry, self._dropout_rng)
+                    out = self._flush_step(self.state, carry, self._dropout_rng)
+                    self.state, loss = out[:2]
                 else:
-                    self.state, loss, train_auc = self._flush_step(
-                        self.state, carry, train_auc)
+                    out = self._flush_step(self.state, carry, train_auc)
+                    self.state, loss, train_auc = out[:3]
                 carry = None
                 n_steps += 1
                 pending.append((loss, 1, self._logged_steps + n_steps))
@@ -1128,7 +1316,7 @@ class Trainer:
                 jax.profiler.stop_trace()
         flush_checks()
         self._flush_cache_sync()  # epoch boundary: leave the tables flushed
-        dt = time.perf_counter() - t0
+        dt = time.monotonic() - t0
         ran = n_steps - start_step  # steps actually executed THIS session
         self._logged_steps += n_steps
         avg = loss_sum / contributed if contributed else 0.0
@@ -1143,14 +1331,23 @@ class Trainer:
         )
         return avg
 
+    def _run_cache_flush(self) -> dict:
+        """One cache write-back dispatch.  With telemetry counters on, the
+        flush program returns a third element (the flush-scoped counter
+        dict) — stash it for the next log boundary.  Returns overflow."""
+        if self._counters_on:
+            self.state, over, self._flush_ctrs = self._cache_flush(self.state)
+        else:
+            self.state, over = self._cache_flush(self.state)
+        return over
+
     def _flush_cache_sync(self) -> None:
         """Write the update cache back NOW and verify zero admission
         overflow — the synchronous flush used at checkpoint, eval, and
         epoch boundaries (no-op when the cache is off)."""
         if self._cache_flush is None:
             return
-        self.state, over = self._cache_flush(self.state)
-        _check_cache_overflow(over)
+        _check_cache_overflow(self._run_cache_flush())
 
     # ----------------------------------------------------------------- eval
 
@@ -1238,6 +1435,8 @@ class Trainer:
         }
         for batch in self._eval_batches():
             acc = self.eval_accum(self.state, batch, acc)
+            if self._watchdog is not None:  # eval batches count as liveness
+                self._watchdog.beat(self._logged_steps)
         w = max(float(acc["w_sum"]), 1.0)
         metrics = {
             "eval_loss": float(acc["loss_sum"]) / w,
@@ -1257,6 +1456,8 @@ class Trainer:
         rename = lambda raw: {"seqs": raw["eval_seqs"], "cands": raw["candidate_items"]}
         for batch in self._eval_batches(rename, pattern=pattern):
             acc = self.eval_accum(self.state, batch, acc)
+            if self._watchdog is not None:  # eval batches count as liveness
+                self._watchdog.beat(self._logged_steps)
         w = max(float(acc.pop("w_sum")), 1.0)
         metrics = {prefix + k: float(v) / w for k, v in acc.items()}
         self.logger.log(epoch=epoch, **metrics)
@@ -1297,6 +1498,8 @@ class Trainer:
         state.  Checkpoints without a cursor sidecar are the legacy
         epoch-indexed format and resume at the following epoch."""
         cfg = self.config
+        if self._watchdog is not None:
+            self._watchdog.start()
         start_epoch = 0
         resume = {"step": 0, "loss_sum": 0.0, "contributed": 0}
         if self._ckpt is not None:
@@ -1334,6 +1537,11 @@ class Trainer:
                                  contributed=resume["contributed"])
                 resume = {"step": 0, "loss_sum": 0.0, "contributed": 0}
                 metrics = self.evaluate(epoch)
+                if epoch == start_epoch and obs_events.active():
+                    # every program of the steady-state cadence (train step,
+                    # cache flush, eval accum) has compiled by the end of the
+                    # first epoch+eval cycle; later compiles are retraces
+                    obs_events.mark_warmup()
                 if self._ckpt is not None and (
                     (epoch + 1) % cfg.checkpoint_every_n_epochs == 0
                     or epoch == cfg.n_epochs - 1
@@ -1352,7 +1560,15 @@ class Trainer:
             metrics.update(self.evaluate_test())
         finally:
             # crash or success: release the JSONL/TB handles and the orbax
-            # manager's background machinery (both leaked on error before)
+            # manager's background machinery (both leaked on error before),
+            # stop the watchdog thread, and detach the compile-event handler
+            # (with the run-peak device-memory watermark as its last record)
+            if self._watchdog is not None:
+                self._watchdog.stop()
+            if obs_events.active():
+                obs_events.record("run_summary",
+                                  peak_bytes=obs_events.peak_memory())
+                obs_events.configure(None)
             self.logger.close()
             if self._ckpt is not None:
                 self._ckpt.close()

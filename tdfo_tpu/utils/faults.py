@@ -17,6 +17,11 @@ the retry layer consult:
   * ``fail_io_nth = N``  — the Nth I/O operation protected by
     ``tdfo_tpu/utils/retry.py`` raises an injected ``OSError`` (once); the
     retry's next attempt proceeds, proving backoff+retry end-to-end.
+  * ``stall_at_step = N`` + ``stall_seconds = S``  — the training loop
+    sleeps S wall-clock seconds after global data step N completes (once),
+    simulating a hung data source / wedged collective so the stall watchdog
+    (``tdfo_tpu/obs/watchdog.py``) is testable end-to-end.  State evolution
+    is untouched — the stall is pure host-side latency.
 
 All triggers key on run-global DATA position (batches consumed), which is
 monotone across rollbacks and resumes — ``state.step`` is not (rollback
@@ -47,14 +52,18 @@ class FaultSpec:
     kill_at_step: int = 0
     nan_at_step: int = 0
     fail_io_nth: int = 0
+    stall_at_step: int = 0
+    stall_seconds: float = 0.0
 
     def __post_init__(self) -> None:
-        for name in ("kill_at_step", "nan_at_step", "fail_io_nth"):
+        for name in ("kill_at_step", "nan_at_step", "fail_io_nth",
+                     "stall_at_step", "stall_seconds"):
             if getattr(self, name) < 0:
                 raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
 
     def any(self) -> bool:
-        return bool(self.kill_at_step or self.nan_at_step or self.fail_io_nth)
+        return bool(self.kill_at_step or self.nan_at_step
+                    or self.fail_io_nth or self.stall_at_step)
 
 
 class FaultInjector:
@@ -65,6 +74,7 @@ class FaultInjector:
         self.workdir = Path(workdir) if workdir else None
         self._io_count = 0
         self._io_fired = False
+        self._stall_fired = False
 
     # ------------------------------------------------------------- kill
 
@@ -114,6 +124,20 @@ class FaultInjector:
             "faults.nan_at_step needs a float-typed batch column to poison; "
             "this workload ships integer-only batches"
         )
+
+    # ------------------------------------------------------------- stall
+
+    def maybe_stall(self, global_step: int) -> None:
+        """Sleep ``stall_seconds`` once when the stall trigger is due — a
+        deterministic stand-in for a hung shard read or wedged collective.
+        Purely host-side: device state and the data cursor are untouched."""
+        if (not self.spec.stall_at_step or self._stall_fired
+                or global_step < self.spec.stall_at_step):
+            return
+        self._stall_fired = True
+        print(f"[faults] injected {self.spec.stall_seconds:.1f}s stall at "
+              f"global step {global_step}", flush=True)
+        time.sleep(self.spec.stall_seconds)
 
     # --------------------------------------------------------------- io
 

@@ -16,7 +16,12 @@ an Event proto is just::
             string file_version = 3;     # first record only
             Summary summary = 5; }
     Summary { repeated Value value = 1; }
-    Value   { string tag = 1; float simple_value = 2; }
+    Value   { string tag = 1; float simple_value = 2;
+              HistogramProto histo = 5; }
+    HistogramProto { double min = 1; double max = 2; double num = 3;
+                     double sum = 4; double sum_squares = 5;
+                     repeated double bucket_limit = 6 [packed];
+                     repeated double bucket = 7 [packed]; }
 
 Cross-validated against TensorFlow's own ``summary_iterator`` in
 ``tests/test_tensorboard.py`` (TF happens to be in the test image; the
@@ -29,6 +34,8 @@ import socket
 import struct
 import time
 from pathlib import Path
+
+import numpy as np
 
 from tdfo_tpu.data.tfrecord import _ld as _bytes_field
 from tdfo_tpu.data.tfrecord import _masked_crc, _varint
@@ -50,6 +57,24 @@ def _float_field(num: int, v: float) -> bytes:
 
 def _varint_field(num: int, v: int) -> bytes:
     return _field(num, 0) + _varint(v & (2**64 - 1))  # int64 two's complement
+
+
+def _packed_doubles(num: int, vals) -> bytes:
+    return _bytes_field(num, b"".join(struct.pack("<d", float(v))
+                                      for v in vals))
+
+
+def _histogram_proto(values: np.ndarray, bins: int) -> bytes:
+    counts, edges = np.histogram(values, bins=bins)
+    # bucket_limit[i] is bucket i's RIGHT edge (TB's HistogramProto
+    # convention); min/max/num/sum/sum_squares feed the distribution chart
+    return (_double_field(1, float(values.min()))
+            + _double_field(2, float(values.max()))
+            + _double_field(3, float(values.size))
+            + _double_field(4, float(values.sum()))
+            + _double_field(5, float((values * values).sum()))
+            + _packed_doubles(6, edges[1:])
+            + _packed_doubles(7, counts))
 
 
 def _event(wall_time: float, *, step: int | None = None,
@@ -97,6 +122,23 @@ class TBScalarWriter:
         # baseline point distinct from epoch 0
         self._write(_event(wall_time if wall_time is not None else time.time(),
                            step=int(step), scalars=values))
+
+    def histogram(self, step: int, tag: str, values,
+                  wall_time: float | None = None, bins: int = 30) -> None:
+        """One histogram summary (grad/param norm distributions from the
+        telemetry counter registry).  Cross-validated against TF's
+        ``summary_iterator`` like the scalar path."""
+        v = np.asarray(values, np.float64).ravel()
+        v = v[np.isfinite(v)]
+        if v.size == 0:
+            return
+        value = (_bytes_field(1, tag.encode())
+                 + _bytes_field(5, _histogram_proto(v, bins)))
+        payload = (_double_field(1, wall_time if wall_time is not None
+                                 else time.time())
+                   + _varint_field(2, int(step))
+                   + _bytes_field(5, _bytes_field(1, value)))
+        self._write(payload)
 
     def close(self) -> None:
         self._f.close()

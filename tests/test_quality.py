@@ -143,6 +143,75 @@ def test_no_jnp_unique_in_device_code():
         + ", ".join(offenders))
 
 
+def test_no_wall_clock_differencing_around_device_work():
+    """`jax.block_until_ready` does NOT wait for device execution through
+    the tunnel, so `time.time()` / `time.perf_counter()` differencing
+    measures RPC noise, not compute — the only honest device timing is
+    chain differencing (`bench.chain_time`, CLAUDE.md).  The rule: no
+    subtraction may involve those calls (or a name bound from one) in the
+    package or the bench drivers, except the sanctioned chain-timer
+    itself.  Host-loop timing stays legal via `time.monotonic` (the
+    trainer's examples/sec, the watchdog's injectable clock) and bare
+    timestamp USE (no differencing) is untouched."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    files = sorted(root.rglob("*.py")) + sorted(root.parent.glob("bench*.py"))
+    SANCTIONED = {("bench.py", "chain_time")}
+
+    def is_wall_call(node):
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("time", "perf_counter")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time")
+
+    offenders, sanctioned_hits = [], 0
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        parents = {}
+        for node in ast.walk(tree):
+            for ch in ast.iter_child_nodes(node):
+                parents[ch] = node
+
+        def enclosing_funcs(node):
+            out = []
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(node.name)
+            return out
+
+        tainted = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and is_wall_call(node.value):
+                tainted.update(t.id for t in node.targets
+                               if isinstance(t, ast.Name))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            sides = (node.left, node.right)
+            if not (any(is_wall_call(s) for s in sides)
+                    or any(isinstance(s, ast.Name) and s.id in tainted
+                           for s in sides)):
+                continue
+            if any((path.name, fn) in SANCTIONED
+                   for fn in enclosing_funcs(node)):
+                sanctioned_hits += 1
+                continue
+            offenders.append(f"{path}:{node.lineno}")
+    assert sanctioned_hits > 0  # the scanner sees the sanctioned site
+    assert not offenders, (
+        "time.time()/time.perf_counter() differencing outside "
+        "bench.chain_time (dishonest device timing through the tunnel — "
+        "use chain differencing, or time.monotonic for host-loop wall "
+        "time): " + ", ".join(offenders))
+
+
 def test_no_precisionless_dots_in_kernel_code():
     """f32 `dot_general` INSIDE Mosaic kernels silently runs bf16 passes at
     default precision (~1e-3 rel error — enough to poison optimizer state;

@@ -147,10 +147,58 @@ def test_latency_jsonl(tmp_path):
     reqs = [r for r in records if r.get("event") == "serve_request"]
     assert [r["request"] for r in reqs] == ["r0", "r1", "r2", "r3"]
     assert all(r["rows"] == 2 and r["padded"] == 8 for r in reqs)
+    # saturation observability: deadline 0 ships every request alone, so
+    # the queue is empty after each ship and the 8-row program is 1/4 used
+    assert all(r["queue_depth"] == 0 for r in reqs)
+    assert all(r["batch_fill"] == 0.25 for r in reqs)
     summary = [r for r in records if r.get("event") == "serve_summary"]
     assert len(summary) == 1 and summary[0]["requests"] == 4
     assert stats["requests"] == 4 and stats["batches"] == 4
     assert stats["p99_ms"] >= stats["p50_ms"] >= 0.0
+
+
+def test_queue_depth_counts_waiting_requests(tmp_path):
+    """queue_depth is the number of requests still pending AFTER a ship —
+    a saturated frontend shows a growing number in the latency JSONL."""
+    logger = MetricLogger(tmp_path)
+    score, _ = _counting_score()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=1e9,
+                      logger=logger, clock=FakeClock())
+    # 3 one-row stragglers queue, then a 5-row request fills the batch;
+    # two more stragglers arrive before the drain ships them
+    for i in range(3):
+        mb.submit(f"s{i}", {"x": np.arange(1)})
+    assert mb.shipped == []  # nothing full yet
+    mb.submit("big", {"x": np.arange(5)})
+    assert mb.shipped == [(8, 8)]
+    mb.submit("late0", {"x": np.arange(2)})
+    mb.submit("late1", {"x": np.arange(2)})
+    mb.drain()
+    logger.close()
+    records = [json.loads(l) for l in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    depth = {r["request"]: r["queue_depth"] for r in records
+             if r.get("event") == "serve_request"}
+    fill = {r["request"]: r["batch_fill"] for r in records
+            if r.get("event") == "serve_request"}
+    assert depth["s0"] == depth["big"] == 0  # full ship drained the queue
+    assert depth["late0"] == depth["late1"] == 0
+    assert fill["big"] == 1.0 and fill["late0"] == 0.5
+
+
+def test_program_cache_invariant_is_a_runtime_assertion():
+    """When the scorer exposes its compiled-program count, every ship
+    checks it against len(buckets) — a shape leak fails LOUDLY in prod,
+    not just in the test suite."""
+    score, _ = _counting_score()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=0.0,
+                      clock=FakeClock(), program_cache_size=lambda: 1)
+    mb.run([("ok", {"x": np.arange(3)})])  # 1 program for 1 bucket: fine
+    leaky = MicroBatcher(score, buckets=(8,), max_batch=8,
+                         batch_deadline_ms=0.0, clock=FakeClock(),
+                         program_cache_size=lambda: 2)
+    with pytest.raises(RuntimeError, match="bounded-jit-cache"):
+        leaky.submit("r", {"x": np.arange(8)})
 
 
 # ------------------------------------------------- compile-count regression

@@ -32,6 +32,55 @@ def test_tf_reads_our_events(tmp_path):
     assert all(ev.wall_time > 0 for ev in events)
 
 
+def test_tf_reads_our_histograms(tmp_path):
+    """The minimal HistogramProto encoding round-trips through TF's own
+    reader: min/max/num/sum/sum_squares and the packed bucket arrays match
+    np.histogram exactly; non-finite values are filtered, and an
+    all-non-finite input writes nothing."""
+    w = TBScalarWriter(tmp_path)
+    finite = np.linspace(-1.0, 2.0, 50)
+    vals = np.concatenate([finite, [np.nan, np.inf, -np.inf]])
+    w.histogram(7, "grad_norm_dist", vals, wall_time=123.0, bins=8)
+    w.histogram(8, "empty_dist", [np.nan, np.inf])  # filtered to nothing
+    w.close()
+    events = _read_events(tmp_path)
+    histos = [(ev, v) for ev in events for v in ev.summary.value
+              if v.HasField("histo")]
+    assert len(histos) == 1  # the all-non-finite histogram was dropped
+    ev, v = histos[0]
+    assert ev.step == 7 and ev.wall_time == 123.0
+    assert v.tag == "grad_norm_dist"
+    counts, edges = np.histogram(finite, bins=8)
+    h = v.histo
+    assert h.min == finite.min() and h.max == finite.max()
+    assert h.num == finite.size
+    np.testing.assert_allclose(h.sum, finite.sum())
+    np.testing.assert_allclose(h.sum_squares, (finite * finite).sum())
+    # bucket_limit[i] is bucket i's RIGHT edge (TB convention)
+    np.testing.assert_allclose(list(h.bucket_limit), edges[1:])
+    np.testing.assert_array_equal(list(h.bucket), counts)
+
+
+def test_metric_logger_flushes_norm_histograms(tmp_path):
+    """MetricLogger buffers every grad/param norm it logs and close()
+    flushes ONE run-wide distribution histogram per tag."""
+    from tdfo_tpu.train.trainer import MetricLogger
+
+    lg = MetricLogger(tmp_path, tensorboard=True)
+    for i, g in enumerate((0.5, 1.5, 2.5)):
+        lg.log(global_step=i, train_loss=0.1, grad_norm=g, param_norm=10.0)
+    lg.close()
+    events = _read_events(tmp_path)
+    hist_tags = {v.tag for ev in events for v in ev.summary.value
+                 if v.HasField("histo")}
+    assert hist_tags == {"grad_norm_dist", "param_norm_dist"}
+    for ev in events:
+        for v in ev.summary.value:
+            if v.tag == "grad_norm_dist":
+                assert v.histo.num == 3 and v.histo.min == 0.5
+                assert v.histo.max == 2.5
+
+
 def test_trainer_tensorboard_knob(tmp_path):
     """Config(tensorboard=true) must produce a parseable events file with
     the training curves (every config key DOES something)."""
