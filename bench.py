@@ -266,7 +266,8 @@ def _make_criteo_host_batch(rng: np.random.Generator, b: int,
 
 
 def build_criteo_train_bench(batch_size: int, embed_dim: int,
-                             hot_vocab: int = 0, powerlaw: bool = False):
+                             hot_vocab: int = 0, powerlaw: bool = False,
+                             fused_threshold: int | None = None):
     """DLRM over the Criteo-Kaggle table profile (26 tables, 33.76M rows):
     the BASELINE.json north-star metric measured directly.  Big tables live
     in ONE fused rowwise-adagrad fat-line stack (4 packed rows per 128-lane
@@ -283,6 +284,12 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int,
     concentrates on the head like real Criteo traffic does.  ``powerlaw``
     alone keeps the single-table layout under the same skewed traffic —
     the honest ablation baseline.
+
+    ``fused_threshold`` overrides the storage/update path for the big
+    tables: ``None`` (default) keeps everything in plain 2D stacks — the
+    measured-fastest layout for this profile — while a vocab threshold
+    routes the tables above it into the fused rowwise-adagrad fat-line
+    stack (the config-defaults build; the planner bench's "defaults" arm).
     """
     import jax
     import jax.numpy as jnp
@@ -316,7 +323,7 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int,
                    for c, v in size_map.items()}
     coll = ShardedEmbeddingCollection(
         generic_embedding_specs(size_map, cats, embed_dim, "row",
-                                fused_threshold=None),
+                                fused_threshold=fused_threshold),
         mesh=mesh, stack_tables=True, fused_kind="rowwise_adagrad",
         hot_ids=hot_ids,
     )
@@ -428,6 +435,76 @@ def build_criteo_train_bench(batch_size: int, embed_dim: int,
 
     return (run, make_args, b, floor_bytes_fn, flops_per_example, hot_info,
             counters_probe)
+
+
+def bench_planner_dlrm(batch_size: int, embed_dim: int, *,
+                       on_tpu: bool,
+                       headline_step_ms: float | None = None) -> dict:
+    """Planner-chosen vs all-defaults placement on the DLRM-Criteo profile
+    (the ``planner_dlrm8`` record).
+
+    The auto-sharding planner (``tdfo_tpu/plan``) prices every per-table
+    placement from the measured v5e cost table over the SAME uniform-id
+    traffic this benchmark generates (uniform per-id counts -> occupancy
+    uniques, exactly the ``_make_criteo_host_batch`` distribution).  The
+    predicted numbers are pure host math and always present; the measured
+    arms (chain-differenced like the headline) run on TPU only:
+
+      * ``step_ms_default`` — what the config defaults build: fused
+        fat-line storage for every table above the 16384-row threshold;
+      * ``step_ms_chosen`` — the planner's placement.  On this profile the
+        planner keeps the big tables PLAIN (docs/BUDGET.md: 22.4 vs
+        29-32 ms measured), so when no big table chose fused the arm is the
+        headline configuration and reuses its measurement instead of
+        re-timing a byte-identical program (one TPU job at a time; a rerun
+        would only add tunnel noise).
+
+    Hot-head choices are priced into the prediction but NOT rebuilt in the
+    measured arms — the storage/update-path decision is the arm under test;
+    the hot-split payoff is measured separately (``--hot-vocab`` /
+    ``record["hot_cold"]``).
+    """
+    import jax
+
+    from tdfo_tpu.plan import plan_digest, plan_tables, table_stats_from_counts
+    from tdfo_tpu.plan.planner import FUSED_MIN_VOCAB
+
+    b = batch_size * max(1, jax.device_count())
+    stats = {f"cat_{i}": table_stats_from_counts(np.ones(v, np.int64))
+             for i, v in enumerate(CRITEO_KAGGLE_VOCABS)}
+    plan = plan_tables(stats, dim=embed_dim, batch_size=b,
+                       optimizer="rowwise_adagrad", dense_model="dlrm",
+                       n_devices=1)
+    tables = plan["tables"]
+    rec = {
+        "plan_digest": plan_digest(plan),
+        "predicted_chosen_ms": plan["predicted_step_ms"],
+        "predicted_default_ms": plan["predicted_default_ms"],
+        "predicted_speedup": round(
+            plan["predicted_default_ms"] / plan["predicted_step_ms"], 3),
+        "fused_tables": int(sum(t["fused"] for t in tables.values())),
+        "hot_tables": int(sum(t["hot_k"] > 0 for t in tables.values())),
+        "bf16_tables": int(sum(t["dtype"] == "bfloat16"
+                               for t in tables.values())),
+    }
+    if not on_tpu:
+        return rec
+    run_d, make_args_d, *_ = build_criteo_train_bench(
+        batch_size, embed_dim, fused_threshold=FUSED_MIN_VOCAB)
+    rec["step_ms_default"] = round(chain_time(run_d, make_args_d) * 1e3, 3)
+    big_fused = any(t["vocab"] > FUSED_MIN_VOCAB and t["fused"]
+                    for t in tables.values())
+    if not big_fused and headline_step_ms is not None:
+        rec["step_ms_chosen"] = round(headline_step_ms, 3)
+        rec["chosen_is_headline"] = True
+    else:
+        run_c, make_args_c, *_ = build_criteo_train_bench(
+            batch_size, embed_dim,
+            fused_threshold=FUSED_MIN_VOCAB if big_fused else None)
+        rec["step_ms_chosen"] = round(chain_time(run_c, make_args_c) * 1e3, 3)
+    rec["measured_speedup"] = round(
+        rec["step_ms_default"] / rec["step_ms_chosen"], 3)
+    return rec
 
 
 def build_sparse_train_bench(batch_size: int, embed_dim: int,
@@ -1032,6 +1109,9 @@ def main() -> None:
     ap.add_argument("--skip-cache", action="store_true",
                     help="skip the update-cache amortization record "
                          "(cache_zipf)")
+    ap.add_argument("--skip-planner", action="store_true",
+                    help="dlrm-criteo only: skip the planner-vs-defaults "
+                         "record (planner_dlrm8)")
     ap.add_argument("--hot-vocab", type=int, default=0,
                     help="dlrm-criteo only: split every table's [0, K) "
                          "frequency-ranked prefix into a replicated hot head "
@@ -1138,6 +1218,21 @@ def main() -> None:
         except Exception as e:  # cache record must never kill the headline
             print(f"bench: cache bench failed: {e!r}", file=sys.stderr)
 
+    planner_rec = {}
+    if args.model == "dlrm-criteo" and not args.skip_planner:
+        # predictions are cheap host math and always emitted; the measured
+        # arms only run on TPU under the DEFAULT (uniform-id) traffic the
+        # planner's synthetic stats describe
+        uniform = not args.hot_vocab and not args.powerlaw
+        try:
+            planner_rec = bench_planner_dlrm(
+                args.batch_size, args.embed_dim,
+                on_tpu=on_tpu and uniform,
+                headline_step_ms=sec_per_step * 1e3 if uniform else None,
+            )
+        except Exception as e:  # planner record must never kill the headline
+            print(f"bench: planner bench failed: {e!r}", file=sys.stderr)
+
     repo = Path(__file__).parent
     baseline_path = repo / "BENCH_BASELINE.json"
     model_name = "twotower" if args.dense else args.model
@@ -1174,6 +1269,7 @@ def main() -> None:
         "big_table_demo": big_table,
         "serving": serving,
         "cache_zipf": cache_zipf,
+        "planner_dlrm8": planner_rec,
         "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,

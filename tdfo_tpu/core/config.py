@@ -235,6 +235,33 @@ class TelemetrySpec:
 
 
 @dataclass(frozen=True)
+class PlannerSpec:
+    """``[planner]`` config table: cost-model-driven auto-sharding
+    (``tdfo_tpu/plan``; torchrec ``EmbeddingShardingPlanner`` parity).
+
+    ``python -m tdfo_tpu.launch plan --config ...`` prices every per-table
+    placement against the measured v5e cost table (``plan/costs.py``) using
+    the preprocessing traffic stats (``table_stats.json``) and writes a
+    deterministic ``sharding_plan.json``; setting ``plan`` to that path
+    makes the trainer apply it as per-table spec overrides (sharding /
+    fused storage / dtype / hot split) and stamp its digest into
+    checkpoints.
+    """
+
+    # path to a sharding_plan.json consumed at train time ("" = no plan;
+    # the hand-set global knobs apply).  A plan OWNS the per-table levers,
+    # so it conflicts with hot_vocab / cache_rows / non-f32 dtypes
+    # (validated below) — those must come from the plan, not the config.
+    plan: str = ""
+    # per-device HBM budget the PLANNING step must fit allocated table +
+    # optimizer-slot bytes under (128-lane padding included); 0 = unlimited.
+    hbm_gb: float = 0.0
+    # device count the plan targets (row shards divide descriptor work and
+    # bytes by this; table-wise placement balances across it).
+    n_devices: int = 1
+
+
+@dataclass(frozen=True)
 class Config:
     """Unified training configuration.
 
@@ -360,6 +387,7 @@ class Config:
     serving: ServingSpec = field(default_factory=ServingSpec)
     # [telemetry] table: flight-recorder knobs (tdfo_tpu/obs)
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
+    planner: PlannerSpec = field(default_factory=PlannerSpec)
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
     # --- runtime knobs ---
@@ -592,6 +620,41 @@ class Config:
         if self.telemetry.stall_timeout_s < 0:
             raise ValueError(
                 "telemetry stall_timeout_s must be >= 0 (0 = watchdog off)")
+        if self.planner.hbm_gb < 0:
+            raise ValueError(
+                "planner hbm_gb must be >= 0 (0 = unlimited device memory)")
+        if self.planner.n_devices < 1:
+            raise ValueError("planner n_devices must be >= 1")
+        if self.planner.plan:
+            if not (self.model == "dlrm"
+                    or (self.model == "twotower" and self.model_parallel)):
+                raise ValueError(
+                    "planner.plan configures the DMP sparse regime (dlrm, "
+                    "or twotower with model_parallel = true); other regimes "
+                    "would silently ignore the plan")
+            if self.lookup_mode != "gspmd":
+                raise ValueError(
+                    "planner.plan composes with lookup_mode \"gspmd\" only: "
+                    "planned placements (replicated tables, hot heads, "
+                    "table-wise assignment) route inside the jitted step")
+            # the plan OWNS the per-table levers; a config that also sets
+            # them by hand would be silently overridden — refuse instead
+            if self.embeddings.hot_vocab > 0:
+                raise ValueError(
+                    "planner.plan conflicts with embeddings.hot_vocab > 0: "
+                    "the plan embeds its own per-table hot splits")
+            if self.embeddings.cache_rows > 0:
+                raise ValueError(
+                    "planner.plan conflicts with embeddings.cache_rows > 0: "
+                    "the plan prices the update cache itself (and BUDGET.md "
+                    "prices it pessimistically — plans emit cache_rows 0)")
+            if (self.embeddings.table_dtype != "float32"
+                    or self.embeddings.slot_dtype != "float32"
+                    or self.embeddings.table_dtype_overrides):
+                raise ValueError(
+                    "planner.plan conflicts with hand-set embeddings "
+                    "table_dtype/slot_dtype/table_dtype_overrides: storage "
+                    "dtypes are per-table plan decisions")
         if self.train.pipeline_overlap:
             if not self.embeddings.grouped_a2a:
                 raise ValueError(
@@ -641,6 +704,7 @@ _EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
 _TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainSpec)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingSpec)}
 _TELEMETRY_FIELDS = {f.name for f in dataclasses.fields(TelemetrySpec)}
+_PLANNER_FIELDS = {f.name for f in dataclasses.fields(PlannerSpec)}
 
 
 def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any) -> Config:
@@ -720,6 +784,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                 f"unknown telemetry config keys: {sorted(unknown_telemetry)}")
         telemetry = TelemetrySpec(**telemetry_raw)
 
+    planner_raw = raw.pop("planner", {})
+    if isinstance(planner_raw, PlannerSpec):
+        planner = planner_raw
+    else:
+        unknown_planner = set(planner_raw) - _PLANNER_FIELDS
+        if unknown_planner:
+            raise ValueError(
+                f"unknown planner config keys: {sorted(unknown_planner)}")
+        planner = PlannerSpec(**planner_raw)
+
     unknown = set(raw) - _CONFIG_FIELDS
     if unknown:
         raise ValueError(f"unknown config keys: {sorted(unknown)}")
@@ -731,7 +805,7 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
     cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, train=train,
-                 serving=serving, telemetry=telemetry, **raw)
+                 serving=serving, telemetry=telemetry, planner=planner, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

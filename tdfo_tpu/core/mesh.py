@@ -24,6 +24,7 @@ from __future__ import annotations
 import functools
 import math
 import os
+import re
 from typing import Sequence
 
 import jax
@@ -101,11 +102,15 @@ def spoof_cpu_devices(n: int = 8) -> None:
     already imported jax and pinned another platform.
     """
     os.environ["JAX_PLATFORMS"] = "cpu"
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
+    # REPLACE any inherited device-count flag rather than keeping it: the
+    # 2-process multihost workers inherit the pytest parent's 8-device
+    # XLA_FLAGS via Popen(env=...) and must be able to ask for fewer (the
+    # env flag beats jax_num_cpu_devices on this jax version)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
     jax.config.update("jax_platforms", "cpu")
     try:
         jax.config.update("jax_num_cpu_devices", n)

@@ -111,18 +111,31 @@ def run_criteo_preprocessing(
     with open(data_dir / "size_map.json", "w") as f:
         json.dump(size_map, f, indent=4)
 
+    # per-table id lookup counts from the SAME pass-1 frequency scan: id 0
+    # (OOV) folds the below-threshold + missing lookup mass — every row
+    # contributes exactly one lookup per column
+    id_counts_by_col: dict[str, np.ndarray] = {}
+    for i, c in enumerate(CRITEO_CATEGORICAL):
+        kept_counts = [n for _, n in counts[i].most_common() if n >= min_freq]
+        id_counts = np.zeros(size_map[c], np.int64)
+        id_counts[0] = n_rows - sum(kept_counts)
+        id_counts[1:] = kept_counts
+        id_counts_by_col[c] = id_counts
+
+    # always emit the planner's traffic-stats artifact (plan/stats.py):
+    # the auto-sharding planner prices per-table placements from it, and
+    # it costs no extra scan
+    from tdfo_tpu.plan.stats import write_table_stats
+
+    write_table_stats(data_dir, id_counts_by_col)
+
     if hot_vocab > 0:
         from tdfo_tpu.data.hot_ids import hot_ids_from_counts, write_hot_ids
 
         per_table: dict[str, "np.ndarray"] = {}
         coverage: dict[str, float] = {}
-        for i, c in enumerate(CRITEO_CATEGORICAL):
-            kept_counts = [n for _, n in counts[i].most_common() if n >= min_freq]
-            id_counts = np.zeros(size_map[c], np.int64)
-            # id 0 (OOV) folds the below-threshold + missing lookup mass:
-            # every row contributes exactly one lookup per column
-            id_counts[0] = n_rows - sum(kept_counts)
-            id_counts[1:] = kept_counts
+        for c in CRITEO_CATEGORICAL:
+            id_counts = id_counts_by_col[c]
             per_table[c] = hot_ids_from_counts(
                 id_counts, hot_vocab=hot_vocab, hot_fraction=hot_fraction)
             coverage[c] = float(id_counts[per_table[c]].sum() / n_rows)

@@ -211,16 +211,38 @@ def run_ctr_preprocessing(data_dir: str | Path, *, file_num: int = FILE_NUM,
         raise ValueError("interaction user_id outside [0, n_users) of user_id_map")
     if interactions["book_id"].max() >= size_map["item"] or interactions["book_id"].min() < 0:
         raise ValueError("interaction book_id outside [0, n_items) of book_id_map")
+    # per-table id lookup counts from TRAIN-split interaction frequencies;
+    # the small book-categorical tables get theirs by pushing per-item
+    # traffic through each book's encoded feature value
+    train_pairs = split_interactions(interactions, True)
+    stats_counts: dict[str, np.ndarray] = {}
+    for col, vocab_key in (("user_id", "user"), ("item_id", "item")):
+        src = "user_id" if col == "user_id" else "book_id"
+        id_counts = np.zeros(size_map[vocab_key], np.int64)
+        vc = train_pairs[src].value_counts()
+        id_counts[vc.index.to_numpy()] = vc.to_numpy()
+        stats_counts[col] = id_counts
+    feat_by_book = book_features.set_index("book_id")
+    item_counts = stats_counts["item_id"]
+    touched = np.nonzero(item_counts)[0]
+    for col in CATEGORY_COLS:
+        vals = feat_by_book[col].reindex(touched).to_numpy(np.int64)
+        stats_counts[col] = np.bincount(
+            vals, weights=item_counts[touched].astype(np.float64),
+            minlength=size_map[col]).astype(np.int64)
+
+    # always emit the planner's traffic-stats artifact (plan/stats.py):
+    # the auto-sharding planner prices per-table placements from it
+    from tdfo_tpu.plan.stats import write_table_stats
+
+    write_table_stats(data_dir, stats_counts)
+
     if hot_vocab > 0:
         from tdfo_tpu.data.hot_ids import hot_ids_from_counts, write_hot_ids
 
-        train_pairs = split_interactions(interactions, True)
         per_table, coverage = {}, {}
-        for col, vocab_key in (("user_id", "user"), ("item_id", "item")):
-            src = "user_id" if col == "user_id" else "book_id"
-            id_counts = np.zeros(size_map[vocab_key], np.int64)
-            vc = train_pairs[src].value_counts()
-            id_counts[vc.index.to_numpy()] = vc.to_numpy()
+        for col in ("user_id", "item_id"):
+            id_counts = stats_counts[col]
             per_table[col] = hot_ids_from_counts(
                 id_counts, hot_vocab=hot_vocab, hot_fraction=hot_fraction)
             total = max(int(id_counts.sum()), 1)

@@ -11,6 +11,10 @@ Subcommands:
   * ``serve``                — export the newest checkpoint to a serving
     bundle and run the micro-batching scoring frontend (+ a retrieval round
     for TwoTower); knobs live in the ``[serving]`` config table.
+  * ``plan``                 — price every per-table embedding placement
+    against the measured cost model (``tdfo_tpu/plan``) using the
+    preprocessing ``table_stats.json`` and write ``sharding_plan.json``;
+    knobs live in the ``[planner]`` config table.
   * ``preprocess-ctr``       — TwoTower ETL (jax-flax/preprocessing parity).
   * ``preprocess-seq``       — Bert4Rec ETL (torchrec/preprocessing parity).
   * ``preprocess-criteo``    — Criteo-format ETL (BASELINE.json DLRM family).
@@ -40,7 +44,7 @@ def _init_distributed(flag: str) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
     p.add_argument("command", nargs="?", default="train",
-                   choices=["train", "serve", "preprocess-ctr",
+                   choices=["train", "serve", "plan", "preprocess-ctr",
                             "preprocess-seq", "preprocess-criteo", "synth",
                             "synth-criteo"])
     p.add_argument("--config", default="config.toml", help="path to config.toml")
@@ -89,6 +93,42 @@ def main(argv: list[str] | None = None) -> int:
             hot_fraction=cfg.embeddings.hot_fraction,
         )
         print(f"size_map: {size_map}")
+        return 0
+    if args.command == "plan":
+        # pure host work: price placements from the stats artifact and the
+        # measured cost table — no devices, no distributed init needed
+        from tdfo_tpu.plan.planner import format_plan, plan_tables, write_plan
+        from tdfo_tpu.plan.stats import load_table_stats
+
+        if cfg.model not in ("dlrm", "twotower"):
+            raise SystemExit(
+                f"the planner targets the DMP sparse regimes (dlrm / "
+                f"twotower), not model = {cfg.model!r}")
+        stats = load_table_stats(cfg.data_dir)
+        if stats is None:
+            raise SystemExit(
+                f"no table_stats.json under {cfg.data_dir} — re-run "
+                "preprocessing (preprocess-ctr / preprocess-criteo) to "
+                "emit the traffic-stats artifact")
+        served = set(cfg.categorical_features or ())
+        if served:
+            stats = {k: v for k, v in stats.items() if k in served}
+        plan = plan_tables(
+            stats,
+            dim=cfg.embed_dim,
+            # the step's id traffic is the GLOBAL batch: every device's
+            # rows funnel into the same sharded tables
+            batch_size=cfg.per_device_train_batch_size
+            * cfg.planner.n_devices,
+            optimizer=cfg.sparse_optimizer,
+            dense_model="twotower" if cfg.model == "twotower" else "dlrm",
+            n_devices=cfg.planner.n_devices,
+            hbm_gb=cfg.planner.hbm_gb,
+            slot_dtype=cfg.embeddings.slot_dtype,
+        )
+        path = write_plan(cfg.data_dir, plan)
+        print(format_plan(plan))
+        print(f"plan written to {path}")
         return 0
     if args.command == "preprocess-seq":
         from tdfo_tpu.data.seq_preprocessing import run_seq_preprocessing

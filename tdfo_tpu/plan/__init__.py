@@ -1,0 +1,38 @@
+"""Measured-cost auto-sharding planner: ``costs`` (the docs/BUDGET.md
+per-descriptor cost table as an executable model), ``stats`` (per-table
+traffic artifact + telemetry refinement), ``planner`` (placement search +
+``sharding_plan.json`` artifact)."""
+
+from tdfo_tpu.plan.costs import TableLoad, estimate_step_ms, table_hbm_bytes
+from tdfo_tpu.plan.planner import (
+    apply_plan_to_specs,
+    format_plan,
+    load_plan,
+    plan_digest,
+    plan_tables,
+    write_plan,
+)
+from tdfo_tpu.plan.stats import (
+    load_table_stats,
+    refine_stats_from_metrics,
+    table_stats_digest,
+    table_stats_from_counts,
+    write_table_stats,
+)
+
+__all__ = [
+    "TableLoad",
+    "estimate_step_ms",
+    "table_hbm_bytes",
+    "plan_tables",
+    "write_plan",
+    "load_plan",
+    "plan_digest",
+    "format_plan",
+    "apply_plan_to_specs",
+    "load_table_stats",
+    "write_table_stats",
+    "table_stats_from_counts",
+    "table_stats_digest",
+    "refine_stats_from_metrics",
+]

@@ -1,0 +1,393 @@
+"""Measured v5e step-cost table — docs/BUDGET.md as an executable model.
+
+Every constant in this module is a per-descriptor cost fitted to the
+chain-differenced IN-SITU ablations in ``docs/BUDGET.md`` (the cumulative
+piece tables measured on the real chip, NOT isolated-op microbenchmarks —
+the fat-line kernel measured 3x slower in situ than isolated, so isolated
+numbers are banned here).  This is the single sanctioned home for numeric
+cost constants: ``tests/test_quality.py`` rejects ``*_NS``/``*_US``/``*_MS``
+constants anywhere else in the tree, so the measured numbers cannot fork.
+
+Calibration contract (``tests/test_planner.py``): :func:`estimate_step_ms`
+must reproduce BOTH BUDGET.md in-situ step budgets with the correct
+plain-vs-fused ordering —
+
+  * DLRM-Criteo (26 tables, 33.76M rows, d=16, B=8192, rowwise-adagrad,
+    213k ids -> 102k touched rows -> 77k touched lines): plain-scatter
+    22.4 ms, fused fat-line 29-32 ms (plain must win);
+  * TwoTower DMP (7 tables, ~2.4M rows, d=64, B=8192, adam, ~8k touched
+    rows): fused 1.40 ms, plain ~2.8 ms (fused must win).
+
+The model is deliberately descriptor-count-based: BUDGET.md's core finding
+is that sparse steps on v5e (no SparseCore) bottom out at per-descriptor
+issue costs, not bandwidth — the roofline "floor" is meaningless there.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+__all__ = [
+    "TableLoad",
+    "FULL_SLOT_BUFFERS",
+    "SCATTER_BUFFERS",
+    "DEDUPE_NS_PER_ID",
+    "ROW_GATHER_BASE_NS",
+    "EXPAND_NS_PER_ID",
+    "SEGSUM_NS_PER_TARGET",
+    "SCATTER_NS_PER_SLOT_PER_BUFFER",
+    "LINE_GATHER_BASE_NS",
+    "LINE_DMA_BASE_NS_PER_DIR",
+    "A2A_US_PER_TABLE",
+    "DENSE_STEP_MS_AT_B8192",
+    "in_situ_multiplier",
+    "line_geometry",
+    "expected_lines",
+    "one_hot_update_ms",
+    "dense_step_ms",
+    "padded_lane_width",
+    "table_hbm_bytes",
+    "estimate_step_ms",
+]
+
+
+# --------------------------------------------------------------------------
+# per-descriptor constants (ns), fitted to the BUDGET.md cumulative ablations
+# --------------------------------------------------------------------------
+
+# dedupe_ids 2-sort formulation: 0.6 ms for 213k ids (BUDGET.md Criteo row
+# "dedupe sort (213k ids -> 102k slots)"); the 16k-scale measurement is
+# 0.24 ms (CLAUDE.md), i.e. the cost is ~linear in the id count.
+DEDUPE_NS_PER_ID = 2.8
+
+# compact row gather, IN SITU at the Criteo scale: ~3.9 ms for 102k
+# scattered 64 B rows from a 2.2 GB stack (BUDGET.md "+ compact row
+# gather", the ~40 ns/row multi-GB floor).  The BASE here is the
+# small-touch-count rate (~60-90 us for 8192 rows, CLAUDE.md); the in-situ
+# multiplier below ramps it to the measured large-touch-count floor:
+# 13.3 * 3.0 = ~40 ns/row at >= 65k step touches.
+ROW_GATHER_BASE_NS = 13.3
+
+# expand compact rows to [B, d]: ~1.0 ms for 213k gathers from the compact
+# 6.5 MB block (BUDGET.md "+ expand to [B, d]", ~4 ns/row — cache-resident
+# source, so no in-situ ramp applies).
+EXPAND_NS_PER_ID = 4.7
+
+# row segment-sum: cost scales with the TARGET segment count at fixed
+# input (CLAUDE.md: 213k -> [102k, 16] ~4 ms, -> [310k, 16] ~10 ms;
+# BUDGET.md Criteo row says ~4.5 ms).  39 ns/target reproduces the 4 ms
+# fact; sorted/cumsum/one-hot alternatives all measured slower.
+SEGSUM_NS_PER_TARGET = 39.0
+
+# XLA scatter serialization floor: ~60-110 ns per touched slot per
+# scattered buffer (BUDGET.md "+ table scatter + accum scatter": ~11 ms
+# for 102k rows x 2 buffers under rowwise-adagrad).  54 * 2 buffers
+# lands the measured 11 ms at the Criteo profile.
+SCATTER_NS_PER_SLOT_PER_BUFFER = 54.0
+
+# fat-line forward gather, IN SITU: ~10 ms for 77k x 512 B lines
+# (BUDGET.md fused ablation "forward line gather + slot select" — the
+# 512 B line granularity taxes the forward vs 64 B plain rows).  Base is
+# the small-scale line-gather rate (~0.4 ms for ~8k 1 KB lines, BUDGET.md
+# TwoTower "7 lookups" row); 45 * 3.0 = 135 ns/line at the Criteo scale.
+LINE_GATHER_BASE_NS = 45.0
+
+# in-place DMA update kernel: ~80-90 ns/line/direction IN SITU (BUDGET.md
+# fused ablation "fused update kernel": ~14 ms for 77k lines read+write;
+# the isolated 17-35 ns/row figure does NOT hold at that scale).  Base is
+# the small-scale rate (TwoTower kernel ~0.5 ms for ~8k lines both
+# directions); 30 * 2 dirs * 3.0 = 180 ns/line at the Criteo scale.
+LINE_DMA_BASE_NS_PER_DIR = 30.0
+
+# all-to-all launch allowance per sharded table per step (2 collectives
+# per direction): the single-chip bench (bench.py alltoall_per_table8)
+# measures PROGRAM OVERHEAD only and multichip ICI is unmeasured
+# (BUDGET.md grouped-exchange section), so this is a nominal launch cost,
+# not a measured ICI number — it exists so replication wins tiny tables
+# (no exchange) while row sharding wins big ones (descriptor work / n).
+A2A_US_PER_TABLE = 20.0
+
+# one-hot MXU segment-sum update for a replicated hot head / small table:
+# ~100-350 us for vocabs 5k-16k (CLAUDE.md; XLA fuses the one-hot away).
+# Modeled linear in the head size over that range with a floor — the
+# CEILING end of BUDGET.md's hot/cold expected-budget table, because the
+# per-table updates serialize in situ (the fat-line 3x lesson).
+ONE_HOT_BASE_US = 100.0
+ONE_HOT_BASE_VOCAB = 5000
+ONE_HOT_US_PER_ROW = (350.0 - 100.0) / (16384 - 5000)
+ONE_HOT_FLOOR_US = 50.0
+
+# dense fwd+bwd anchors at B=8192, bf16 MXU (BUDGET.md "+ model fwd+bwd"
+# rows): DLRM bottom+top MLPs 1.5 ms, TwoTower towers 0.3 ms.  Scaled
+# linearly in batch (MXU-bound at these widths).
+DENSE_STEP_MS_AT_B8192 = {"dlrm": 1.5, "twotower": 0.3}
+
+# in-situ descriptor-cost ramp: isolated/small-step descriptor rates hold
+# up to ~16k touches per step; at the Criteo scale (~100k touches) every
+# scattered-descriptor cost measured ~3x its small-scale rate (BUDGET.md
+# fused-ablation finding: "the 17-35 ns/row figure from small-scale
+# isolated runs does not hold at 77k lines"; custom calls serialize
+# against the step).  Linear ramp between the two measured regimes,
+# keyed on the STEP's total per-device touched rows — contention is a
+# whole-step property, not a per-table one.
+IN_SITU_RAMP_START = 16384
+IN_SITU_RAMP_FULL = 65536
+IN_SITU_MAX = 3.0
+
+# optimizer state geometry (ops/sparse.py kinds): full table-shaped slot
+# buffers, and the number of scattered buffers a plain update touches
+# (table itself + full slots + the rowwise [V] accumulator cell-scatter).
+FULL_SLOT_BUFFERS = {"sgd": 0, "adagrad": 1, "rowwise_adagrad": 0, "adam": 2}
+SCATTER_BUFFERS = {"sgd": 1, "adagrad": 2, "rowwise_adagrad": 2, "adam": 3}
+
+
+@dataclass(frozen=True)
+class TableLoad:
+    """One table's traffic + placement, as the estimator consumes it.
+
+    ``ids_per_batch``/``unique_rows`` come from the ``table_stats.json``
+    artifact (analytic estimates from preprocessing counts, optionally
+    replaced by observed telemetry counters — ``plan/stats.py``).
+    ``unique_lines`` is the observed fat-line touch count when telemetry
+    recorded one; ``None`` falls back to the occupancy estimate
+    (:func:`expected_lines`).  ``hot_mass`` is the lookup-mass fraction a
+    ``hot_k``-row hot head absorbs (stats head-mass curve)."""
+
+    name: str
+    vocab: int
+    dim: int
+    ids_per_batch: float
+    unique_rows: float
+    unique_lines: float | None = None
+    sharding: str = "row"  # "row" | "replicated" | "table"
+    fused: bool = False
+    dtype: str = "float32"
+    hot_k: int = 0
+    hot_mass: float = 0.0
+
+
+def in_situ_multiplier(total_unique_rows: float) -> float:
+    """Descriptor-cost multiplier for a step touching this many rows."""
+    if total_unique_rows <= IN_SITU_RAMP_START:
+        return 1.0
+    if total_unique_rows >= IN_SITU_RAMP_FULL:
+        return IN_SITU_MAX
+    frac = (total_unique_rows - IN_SITU_RAMP_START) / (
+        IN_SITU_RAMP_FULL - IN_SITU_RAMP_START)
+    return 1.0 + (IN_SITU_MAX - 1.0) * frac
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (int(x) - 1).bit_length())
+
+
+def line_geometry(dim: int, optimizer: str, dtype: str) -> tuple[int, int]:
+    """Fat-line packing of one vocab row: ``(line_elems, rows_per_line)``.
+
+    Mirrors ``ops/pallas_kernels.line_layout``: a row carries
+    ``dim * (1 + full_slots)`` elements (+1 for the rowwise accumulator),
+    padded to a power of two; rows pack into 128-lane f32 lines (256
+    elements for bf16 — half the bytes per element, same 512 B line).
+    """
+    elems = dim * (1 + FULL_SLOT_BUFFERS[optimizer])
+    if optimizer == "rowwise_adagrad":
+        elems += 1
+    width = _next_pow2(elems)
+    lane_elems = 128 if dtype == "float32" else 256
+    rows_per_line = max(1, lane_elems // width)
+    return width, rows_per_line
+
+
+def expected_lines(unique_rows: float, vocab: int, rows_per_line: int) -> float:
+    """Occupancy estimate of touched lines: ``unique_rows`` rows drawn over
+    ``ceil(vocab / R)`` lines touch ``L * (1 - (1 - 1/L)^u)`` of them —
+    saturated small tables compress ~R-fold, sparse big tables barely."""
+    if unique_rows <= 0:
+        return 0.0
+    n_lines = math.ceil(vocab / max(1, rows_per_line))
+    if n_lines <= 1:
+        return 1.0
+    return n_lines * -math.expm1(unique_rows * math.log1p(-1.0 / n_lines))
+
+
+def one_hot_update_ms(hot_rows: int) -> float:
+    """One replicated hot head's scatter-free one-hot MXU update."""
+    us = ONE_HOT_BASE_US + (hot_rows - ONE_HOT_BASE_VOCAB) * ONE_HOT_US_PER_ROW
+    return max(ONE_HOT_FLOOR_US, us) / 1000.0
+
+
+def dense_step_ms(dense_model: str, batch_size: int) -> float:
+    """Dense backbone fwd+bwd, scaled from the measured B=8192 anchors."""
+    if dense_model not in DENSE_STEP_MS_AT_B8192:
+        raise ValueError(f"no dense anchor for model {dense_model!r}")
+    return DENSE_STEP_MS_AT_B8192[dense_model] * (batch_size / 8192.0)
+
+
+# --------------------------------------------------------------------------
+# HBM model (per-device bytes, undivided — the planner applies sharding)
+# --------------------------------------------------------------------------
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2}
+
+
+def padded_lane_width(dim: int) -> int:
+    """XLA's allocated trailing width: narrow dims (8/16) get narrow
+    tiles, everything else lane-pads to a 128 multiple — a [V, 64] table
+    allocates 2x its logical bytes (CLAUDE.md measured fact; same 2x for
+    bf16, which is why bf16 saves exactly half, not more)."""
+    if dim <= 16:
+        return dim
+    return 128 * math.ceil(dim / 128)
+
+
+def table_hbm_bytes(
+    vocab: int,
+    dim: int,
+    *,
+    optimizer: str,
+    dtype: str = "float32",
+    slot_dtype: str = "float32",
+    fused: bool = False,
+    hot_k: int = 0,
+) -> int:
+    """Allocated bytes of one table + its optimizer state (whole table,
+    before any sharding division).  ``hot_k`` adds the replicated dense
+    head (always f32 + dense slot buffers — the head is small)."""
+    dsize = _DTYPE_BYTES[dtype]
+    if fused:
+        width, rows_per_line = line_geometry(dim, optimizer, dtype)
+        lane_elems = 128 if dtype == "float32" else 256
+        if rows_per_line > 1:
+            body = math.ceil(vocab / rows_per_line) * lane_elems * dsize
+        else:
+            body = vocab * width * dsize
+    else:
+        padded = padded_lane_width(dim)
+        body = vocab * padded * dsize
+        body += FULL_SLOT_BUFFERS[optimizer] * vocab * padded * _DTYPE_BYTES[slot_dtype]
+        if optimizer == "rowwise_adagrad":
+            body += vocab * 4  # the EXACT_ROWWISE_ADAGRAD f32 accumulator
+    if hot_k > 0:
+        k = min(hot_k, vocab)
+        head = k * padded_lane_width(dim) * 4 * (1 + FULL_SLOT_BUFFERS[optimizer])
+        if optimizer == "rowwise_adagrad":
+            head += k * 4
+        body += head
+    return int(body)
+
+
+# --------------------------------------------------------------------------
+# step-cost estimator
+# --------------------------------------------------------------------------
+
+
+def estimate_step_ms(
+    loads: list[TableLoad],
+    *,
+    optimizer: str,
+    dense_model: str,
+    batch_size: int,
+    n_devices: int = 1,
+) -> dict:
+    """Predicted per-device train-step milliseconds for a set of placed
+    tables, assuming the measured-fastest formulation of each path:
+
+      * plain tables stack per (dim, dtype, sharding) and run the
+        dedup_lookup pipeline — one dedupe sort, compact row gather,
+        expand, row segment-sum, then one scatter per optimizer buffer
+        (the 22.4 ms Criteo formulation);
+      * fused tables stack into fat-line arrays per (dim, dtype,
+        sharding) — dedupe, line gather, segment-sum, in-place DMA kernel
+        (the 1.40 ms TwoTower formulation);
+      * a ``hot_k`` head removes ``hot_mass`` of the table's traffic from
+        the scattered path and pays one one-hot MXU update per table
+        (heads are per-table and serialize — BUDGET.md hot/cold table).
+
+    Row-sharded groups divide descriptor counts by ``n_devices`` (balanced
+    shards) and pay the a2a launch allowance; replicated and table-wise
+    groups do full-count work per device / on the owner.  Returns a
+    breakdown dict with ``total_ms``, ``dense_ms``, ``hot_ms`` and a
+    ``per_table`` attribution (group costs split by touched-row share).
+    """
+    if optimizer not in SCATTER_BUFFERS:
+        raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+    cold: list[dict] = []
+    hot_ms = 0.0
+    per_table = {ld.name: 0.0 for ld in loads}
+    for ld in loads:
+        ids, uniq = float(ld.ids_per_batch), float(ld.unique_rows)
+        lines = ld.unique_lines
+        if ld.hot_k > 0:
+            k = min(ld.hot_k, ld.vocab)
+            mass = 1.0 if ld.hot_k >= ld.vocab else min(1.0, max(0.0, ld.hot_mass))
+            head_ms = one_hot_update_ms(k)
+            hot_ms += head_ms
+            per_table[ld.name] += head_ms
+            ids *= 1.0 - mass
+            uniq *= 1.0 - mass
+            lines = None if lines is None else lines * (1.0 - mass)
+        cold.append(dict(load=ld, ids=ids, uniq=uniq, lines=lines))
+
+    # the in-situ ramp keys on the step's total per-device touched rows
+    def _div(ld: TableLoad) -> float:
+        return float(n_devices) if ld.sharding == "row" else 1.0
+
+    total_touched = sum(c["uniq"] / _div(c["load"]) for c in cold)
+    m = in_situ_multiplier(total_touched)
+
+    groups: dict[tuple, list[dict]] = {}
+    for c in cold:
+        ld = c["load"]
+        key = (ld.fused, ld.dim, ld.dtype, ld.sharding)
+        groups.setdefault(key, []).append(c)
+
+    sparse_ms = 0.0
+    a2a_ms = 0.0
+    for (fused, dim, dtype, sharding), members in sorted(
+            groups.items(), key=lambda kv: repr(kv[0])):
+        div = float(n_devices) if sharding == "row" else 1.0
+        ids = sum(c["ids"] for c in members)
+        uniq = sum(c["uniq"] for c in members) / div
+        if fused:
+            width, rpl = line_geometry(dim, optimizer, dtype)
+            lines = sum(
+                c["lines"] if c["lines"] is not None else expected_lines(
+                    c["uniq"], c["load"].vocab, rpl)
+                for c in members) / div
+            group_ms = (
+                ids * DEDUPE_NS_PER_ID
+                + lines * LINE_GATHER_BASE_NS * m
+                + uniq * SEGSUM_NS_PER_TARGET
+                + lines * 2 * LINE_DMA_BASE_NS_PER_DIR * m
+            ) / 1e6
+        else:
+            group_ms = (
+                ids * DEDUPE_NS_PER_ID
+                + uniq * ROW_GATHER_BASE_NS * m
+                + ids * EXPAND_NS_PER_ID
+                + uniq * SEGSUM_NS_PER_TARGET
+                # NO in-situ ramp on the scatter: the ~54 ns/slot floor IS
+                # the at-scale in-situ figure (BUDGET.md measured the 102k-
+                # row scatter in the full step; small-scale XLA scatters
+                # are ~170 ns/row, i.e. scatters do not get WORSE at scale)
+                + uniq * SCATTER_NS_PER_SLOT_PER_BUFFER * SCATTER_BUFFERS[optimizer]
+            ) / 1e6
+        sparse_ms += group_ms
+        if sharding in ("row", "table") and n_devices > 1:
+            a2a_ms += len(members) * A2A_US_PER_TABLE / 1000.0
+        g_uniq = sum(c["uniq"] for c in members)
+        for c in members:
+            share = (c["uniq"] / g_uniq) if g_uniq > 0 else 1.0 / len(members)
+            per_table[c["load"].name] += group_ms * share
+
+    dense = dense_step_ms(dense_model, batch_size)
+    return {
+        "total_ms": dense + sparse_ms + hot_ms + a2a_ms,
+        "dense_ms": dense,
+        "sparse_ms": sparse_ms,
+        "hot_ms": hot_ms,
+        "a2a_ms": a2a_ms,
+        "in_situ_multiplier": m,
+        "per_table": per_table,
+    }

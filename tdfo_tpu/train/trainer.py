@@ -618,7 +618,18 @@ class Trainer:
             )
             for s in specs
         ]
+        plan = None
         hot_ids = None
+        if cfg.planner.plan:
+            from tdfo_tpu.plan.planner import apply_plan_to_specs, load_plan
+
+            # cost-model-chosen per-table placement: the plan artifact
+            # rewrites each spec's sharding / fused storage / dtype and
+            # carries its own hot-split id sets (config validation refuses
+            # hot_vocab / cache_rows / hand-set dtypes alongside a plan, so
+            # the plan is the single owner of the per-table levers)
+            plan = load_plan(cfg.planner.plan)
+            specs, hot_ids = apply_plan_to_specs(specs, plan)
         if cfg.embeddings.hot_vocab > 0:
             from tdfo_tpu.data.hot_ids import load_hot_ids
 
@@ -669,6 +680,14 @@ class Trainer:
                 "cache_rows": int(cfg.embeddings.cache_rows),
                 "flush_every": int(cfg.embeddings.flush_every),
             }
+        if plan is not None:
+            from tdfo_tpu.plan.planner import plan_digest
+
+            # a checkpoint written under a plan pairs the whole state
+            # layout (shardings, fat lines, hot heads, dtypes) with that
+            # placement; stamp the plan digest so a restore under a
+            # different plan — or none — refuses instead of mis-routing
+            stamps["sharding_plan"] = plan_digest(plan)
         self._ckpt_stamps = stamps or None
         k_tables, k_dense = jax.random.split(jax.random.key(cfg.seed))
         tables = coll.init(k_tables)

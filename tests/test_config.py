@@ -375,3 +375,55 @@ def test_embeddings_dtype_validation():
     # table bf16 with f32 slots is the rowwise-compatible combination
     Config(model="dlrm", sparse_optimizer="rowwise_adagrad",
            embeddings=EmbeddingsSpec(table_dtype="bfloat16"))
+
+
+def test_planner_table(tmp_path: Path):
+    """The [planner] section maps onto PlannerSpec; unknown keys rejected."""
+    cfg = read_configs()
+    assert cfg.planner.plan == ""
+    assert cfg.planner.hbm_gb == 0.0
+    assert cfg.planner.n_devices == 1
+    (tmp_path / "config.toml").write_text(
+        'model = "dlrm"\n'
+        '[planner]\nplan = "plans/sharding_plan.json"\n'
+        "hbm_gb = 14.5\nn_devices = 8\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.planner.plan == "plans/sharding_plan.json"
+    assert cfg.planner.hbm_gb == 14.5
+    assert cfg.planner.n_devices == 8
+    (tmp_path / "config.toml").write_text("[planner]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="bogus"):
+        read_configs(tmp_path / "config.toml")
+
+
+def test_planner_knob_validation():
+    from tdfo_tpu.core.config import EmbeddingsSpec, PlannerSpec
+
+    with pytest.raises(ValueError, match="hbm_gb"):
+        Config(planner=PlannerSpec(hbm_gb=-1.0))
+    with pytest.raises(ValueError, match="n_devices"):
+        Config(planner=PlannerSpec(n_devices=0))
+    plan = PlannerSpec(plan="sharding_plan.json")
+    # the plan configures the DMP sparse regime only
+    with pytest.raises(ValueError, match="regime"):
+        Config(model="twotower", model_parallel=False, planner=plan)
+    with pytest.raises(ValueError, match="regime"):
+        Config(model="bert4rec", planner=plan)
+    with pytest.raises(ValueError, match="gspmd"):
+        Config(model="dlrm", lookup_mode="alltoall", planner=plan)
+    # the plan OWNS the per-table levers; hand-set knobs must refuse
+    with pytest.raises(ValueError, match="hot_vocab"):
+        Config(model="dlrm", planner=plan,
+               embeddings=EmbeddingsSpec(hot_vocab=128))
+    with pytest.raises(ValueError, match="cache_rows"):
+        Config(model="dlrm", planner=plan,
+               embeddings=EmbeddingsSpec(cache_rows=1024))
+    for hand in (dict(table_dtype="bfloat16"),
+                 dict(table_dtype="bfloat16", slot_dtype="bfloat16"),
+                 dict(table_dtype_overrides={"user": "bfloat16"})):
+        with pytest.raises(ValueError, match="dtype"):
+            Config(model="dlrm", planner=plan,
+                   embeddings=EmbeddingsSpec(**hand))
+    # valid combinations construct fine
+    Config(model="dlrm", planner=plan)
+    Config(model="twotower", model_parallel=True, planner=plan)

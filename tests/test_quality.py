@@ -212,6 +212,52 @@ def test_no_wall_clock_differencing_around_device_work():
         "time): " + ", ".join(offenders))
 
 
+def test_no_cost_constants_outside_cost_model():
+    """`tdfo_tpu/plan/costs.py` is the single sanctioned home for measured
+    per-descriptor cost constants (the executable docs/BUDGET.md): a
+    `*_NS`/`*_US`/`*_MS` number hardcoded anywhere else is a fork of the
+    chip measurements that the planner's calibration test cannot see, and
+    the two copies WILL drift.  The rule: no module-level ALL_CAPS
+    assignment whose name carries an NS/US/MS unit segment outside
+    plan/costs.py (package + bench drivers).  Matching is on `_`-split
+    SEGMENTS, so names like CONTINUOUS_COLS stay legal."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    files = sorted(root.rglob("*.py")) + sorted(root.parent.glob("bench*.py"))
+    sanctioned = root / "plan" / "costs.py"
+
+    def is_cost_name(name: str) -> bool:
+        if not name.isupper():
+            return False
+        return bool({"NS", "US", "MS"} & set(name.split("_")))
+
+    offenders, sanctioned_hits = [], 0
+    for path in files:
+        tree = ast.parse(path.read_text(), filename=str(path))
+        # module level only: locals named like units are not constant forks
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and is_cost_name(t.id):
+                    if path == sanctioned:
+                        sanctioned_hits += 1
+                    else:
+                        offenders.append(f"{path}:{node.lineno} {t.id}")
+    assert sanctioned_hits > 0  # the scanner sees the sanctioned module
+    assert not offenders, (
+        "measured cost constants outside tdfo_tpu/plan/costs.py (the single "
+        "home for chip numbers — add it there with provenance and import "
+        "it): " + ", ".join(offenders))
+
+
 def test_no_precisionless_dots_in_kernel_code():
     """f32 `dot_general` INSIDE Mosaic kernels silently runs bf16 passes at
     default precision (~1e-3 rel error — enough to poison optimizer state;
