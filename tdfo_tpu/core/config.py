@@ -162,7 +162,10 @@ class ServingSpec:
     Every key is observable (``tests/test_config.py``): ``top_k`` is the
     retrieval output width, ``corpus_batch`` the item-tower sweep chunk,
     ``max_batch``/``batch_deadline_ms``/``buckets`` drive micro-batch
-    assembly and the padded-shape set the jit cache may hold.
+    assembly and the padded-shape set the jit cache may hold, and
+    ``max_queue``/``shed_policy``/``swap_poll_s``/``max_bad_deltas`` are the
+    overload/hot-swap resilience knobs (``serve/frontend.py`` admission
+    control, ``serve/swap.py`` delta polling + quarantine).
     """
 
     # retrieved candidates per query (``lax.top_k`` width; ~16 us for an
@@ -183,6 +186,21 @@ class ServingSpec:
     # smallest bucket that fits, so the serving jit cache holds at most
     # ``len(buckets)`` programs — the compile-count regression contract.
     buckets: tuple[int, ...] = (256, 1024, 8192)
+    # admission-queue cap in pending REQUESTS; an arrival beyond it sheds
+    # deadline-expired requests first, then applies shed_policy (0 = the
+    # pre-resilience unbounded queue)
+    max_queue: int = 0
+    # who loses when the bounded queue is still full after deadline sweeps:
+    # "oldest" displaces the longest-waiting request (its latency bound is
+    # nearest to broken anyway), "reject" bounces the new arrival
+    shed_policy: str = "oldest"
+    # how often the serving loop checks the export chain for the successor
+    # delta bundle (serve/swap.py DeltaPoller cadence)
+    swap_poll_s: float = 1.0
+    # consecutive quarantined (digest-corrupt) deltas before the frontend
+    # flips the degraded flag into its heartbeat — still serving the last
+    # good version, but loudly
+    max_bad_deltas: int = 3
 
 
 @dataclass(frozen=True)
@@ -612,6 +630,20 @@ class Config:
             raise ValueError(
                 "serving buckets must be strictly increasing (each padded "
                 "shape compiles one program; duplicates/disorder hide that)")
+        if self.serving.max_queue < 0:
+            raise ValueError(
+                "serving max_queue must be >= 0 (0 = unbounded admission)")
+        if self.serving.shed_policy not in ("oldest", "reject"):
+            raise ValueError(
+                "serving shed_policy must be 'oldest' or 'reject', got "
+                f"{self.serving.shed_policy!r}")
+        if self.serving.swap_poll_s < 0:
+            raise ValueError(
+                "serving swap_poll_s must be >= 0 (0 = poll every tick)")
+        if self.serving.max_bad_deltas < 1:
+            raise ValueError(
+                "serving max_bad_deltas must be >= 1 (how many consecutive "
+                "corrupt deltas flip degraded mode)")
         if self.serving.max_batch > self.serving.buckets[-1]:
             raise ValueError(
                 "serving max_batch must fit the largest bucket: a full batch "

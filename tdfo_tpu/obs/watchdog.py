@@ -33,17 +33,26 @@ class StallWatchdog:
     ``beat(step)`` is called by the train loop each completed step; the
     daemon thread does everything else.  Re-arms after each stall so a
     recovered loop gets fresh detection.
+
+    The serving frontend reuses the same machinery with ``label="serve"``
+    (``beat`` per shipped scoring batch, so a wedged scorer dumps stacks
+    through the identical path as a wedged train step), and publishes its
+    degradation state via :meth:`set_status` — extra key/values merged into
+    every heartbeat record (e.g. ``degraded``/``bad_deltas`` from the swap
+    store's quarantine counter).
     """
 
     def __init__(self, heartbeat_path, timeout_s: float, *,
-                 clock=time.monotonic):
+                 clock=time.monotonic, label: str = "train"):
         self.path = os.fspath(heartbeat_path)
         self.timeout_s = float(timeout_s)
+        self.label = str(label)
         self._clock = clock
         self._lock = threading.Lock()
         self._last_step = -1
         self._last_beat = clock()
         self._stalled = False
+        self._status: dict = {}
         self.stall_events: list = []
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -57,6 +66,16 @@ class StallWatchdog:
             self._last_step = int(step)
             self._last_beat = self._clock()
             self._stalled = False
+
+    def set_status(self, **kv) -> None:
+        """Merge extra fields into every subsequent heartbeat record (the
+        degraded-mode surface: ``set_status(degraded=True, bad_deltas=3)``)."""
+        with self._lock:
+            self._status.update(kv)
+
+    def status(self) -> dict:
+        with self._lock:
+            return dict(self._status)
 
     def start(self) -> "StallWatchdog":
         if self.timeout_s > 0 and self._thread is None:
@@ -85,18 +104,20 @@ class StallWatchdog:
             fresh_stall = age > self.timeout_s and not self._stalled
             if fresh_stall:
                 self._stalled = True
-        self._write({"time": time.time(), "last_step": step,
-                     "step_age_s": age, "stalled": age > self.timeout_s})
+            status = dict(self._status)
+        self._write({"time": time.time(), "label": self.label,
+                     "last_step": step, "step_age_s": age,
+                     "stalled": age > self.timeout_s, **status})
         if fresh_stall:
             dump = self._dump_stacks()
             self.stall_events.append(
                 {"last_step": step, "step_age_s": age})
             self._write({"time": time.time(), "kind": "stall",
-                         "last_step": step, "step_age_s": age,
-                         "stacks": dump})
+                         "label": self.label, "last_step": step,
+                         "step_age_s": age, "stacks": dump, **status})
             logger.warning(
-                "STALL: no step completed in %.1fs (last step %d). "
-                "Thread stacks:\n%s", age, step, dump)
+                "STALL: no %s step completed in %.1fs (last step %d). "
+                "Thread stacks:\n%s", self.label, age, step, dump)
         return fresh_stall
 
     def _dump_stacks(self) -> str:

@@ -14,28 +14,50 @@ millions of users").  Layering, offline to online:
   * :mod:`~tdfo_tpu.serve.retrieval` — sharded exact top-k MIPS, bitwise-equal
     to a single-device argsort reference.
   * :mod:`~tdfo_tpu.serve.frontend`  — deadline/bucket micro-batching request
-    loop with per-request latency JSONL; ``launch.py serve`` entry point.
+    loop with per-request latency JSONL, bounded-queue load shedding, and
+    drain-and-flip hot swap; ``launch.py serve`` entry point.
+  * :mod:`~tdfo_tpu.serve.swap`      — delta-chain bundle store: digest-
+    verified ingest/apply, atomic publication + CURRENT pointer, crash
+    recovery, corrupt-delta quarantine and degraded mode.
 """
 
 from tdfo_tpu.serve.corpus import Corpus, build_corpus, synthetic_item_features
 from tdfo_tpu.serve.export import (
     BUNDLE_VERSION,
     ServingBundle,
+    apply_delta_arrays,
+    bundle_digest,
     export_bundle,
+    export_delta,
     load_bundle,
     merged_tables,
 )
 from tdfo_tpu.serve.frontend import MicroBatcher, serve_from_config
 from tdfo_tpu.serve.retrieval import make_retrieval, mips_scores, retrieval_reference
 from tdfo_tpu.serve.scoring import make_scorer
+from tdfo_tpu.serve.swap import (
+    BundleStore,
+    CorruptDeltaError,
+    DeltaChainError,
+    DeltaPoller,
+    SwapController,
+)
 
 __all__ = [
     "BUNDLE_VERSION",
+    "BundleStore",
     "Corpus",
+    "CorruptDeltaError",
+    "DeltaChainError",
+    "DeltaPoller",
     "MicroBatcher",
     "ServingBundle",
+    "SwapController",
+    "apply_delta_arrays",
     "build_corpus",
+    "bundle_digest",
     "export_bundle",
+    "export_delta",
     "load_bundle",
     "make_retrieval",
     "make_scorer",

@@ -26,6 +26,7 @@ is a directory with a JSON manifest + one ``arrays.npz``:
 
 from __future__ import annotations
 
+import hashlib
 import json
 from dataclasses import dataclass
 from pathlib import Path
@@ -41,9 +42,14 @@ from tdfo_tpu.parallel.embedding import CACHE_PREFIX, ShardedEmbeddingCollection
 __all__ = [
     "BUNDLE_VERSION",
     "ServingBundle",
+    "apply_delta_arrays",
+    "bundle_digest",
     "export_bundle",
+    "export_delta",
     "load_bundle",
     "merged_tables",
+    "read_raw_bundle",
+    "write_raw_bundle",
 ]
 
 # Bundle schema version, stamped into every manifest and verified on load.
@@ -135,6 +141,8 @@ class ServingBundle:
     tables: dict[str, np.ndarray] | None  # sparse kind
     dense_params: dict | None  # sparse kind
     params: dict | None  # dense kind
+    version: int = 0  # chain position (delta exports stack on this)
+    digest: str = ""  # manifest content digest (see bundle_digest)
 
     @property
     def jax_dtype(self):
@@ -179,6 +187,52 @@ def _load_stored(arr: np.ndarray, dtype_name: str) -> np.ndarray:
     return arr
 
 
+def bundle_digest(manifest: Mapping[str, Any],
+                  arrays: Mapping[str, np.ndarray]) -> str:
+    """Content digest of a bundle: canonical manifest (minus ``digest``) +
+    every STORED array's key/dtype/shape/bytes, sha256 truncated to 16 hex.
+
+    Computed over the stored representation (bf16 ships as uint16 bit
+    patterns), so the digest is stable across save/load round trips —
+    ``np.savez`` container bytes are NOT hashed (zip metadata is not
+    deterministic)."""
+    core = {k: v for k, v in manifest.items() if k != "digest"}
+    h = hashlib.sha256(json.dumps(core, sort_keys=True).encode())
+    for k in sorted(arrays):
+        a = np.ascontiguousarray(arrays[k])
+        h.update(k.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
+def read_raw_bundle(bundle_dir: str | Path) -> tuple[dict, dict[str, np.ndarray]]:
+    """Read a bundle/delta directory as (manifest, STORED arrays) — no
+    dtype view-back, no validation beyond file presence.  The form
+    :func:`bundle_digest` hashes; the swap store verifies on top of this."""
+    bdir = Path(bundle_dir)
+    mpath = bdir / _MANIFEST
+    if not mpath.exists():
+        raise ValueError(f"{bdir} is not a serving bundle (no {_MANIFEST})")
+    manifest = json.loads(mpath.read_text())
+    with np.load(bdir / _ARRAYS) as z:
+        arrays = {k: z[k] for k in z.files}
+    return manifest, arrays
+
+
+def write_raw_bundle(out_dir: str | Path, manifest: Mapping[str, Any],
+                     arrays: Mapping[str, np.ndarray]) -> Path:
+    """Write arrays.npz first, manifest last (the manifest is the commit
+    point a reader keys off).  Durable/atomic publication of whole bundle
+    directories is :mod:`tdfo_tpu.serve.swap`'s job, not this writer's."""
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    np.savez(out / _ARRAYS, **arrays)
+    (out / _MANIFEST).write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    return out
+
+
 def export_bundle(
     out_dir: str | Path,
     *,
@@ -195,6 +249,7 @@ def export_bundle(
     caches: Mapping[str, Any] | None = None,
     mixed_precision: bool = False,
     platform: str | None = None,
+    version: int = 0,
 ) -> Path:
     """Write a serving bundle directory and return its path.
 
@@ -206,16 +261,30 @@ def export_bundle(
     flushed).  ``mixed_precision=True`` applies the platform cast policy
     (:func:`compute_dtype`: bf16 on TPU) to every floating array; the default
     keeps f32 so serving logits stay bitwise equal to training eval logits.
+    ``version`` is the bundle's chain position (delta exports stack on top
+    of it, :func:`export_delta`); the manifest also stamps a content
+    ``digest`` so consumers can verify integrity end to end.
     """
     if (coll is None) == (params is None):
         raise ValueError(
             "export_bundle takes either coll+tables+dense_params (sparse "
             "regime) or params (dense regime), not both/neither")
     dtype = compute_dtype(mixed_precision, platform)
-    dtype_name = jnp.dtype(dtype).name
-    out = Path(out_dir)
-    out.mkdir(parents=True, exist_ok=True)
+    manifest, arrays = _materialize(
+        model=model, embed_dim=embed_dim, cat_columns=cat_columns,
+        cont_columns=cont_columns, size_map=size_map, step=step, coll=coll,
+        tables=tables, dense_params=dense_params, params=params,
+        caches=caches, dtype=dtype, version=version)
+    manifest["digest"] = bundle_digest(manifest, arrays)
+    return write_raw_bundle(out_dir, manifest, arrays)
 
+
+def _materialize(
+    *, model, embed_dim, cat_columns, cont_columns, size_map, step, coll,
+    tables, dense_params, params, caches, dtype, version,
+) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
+    """Shared bundle materialization: (manifest sans digest, stored arrays)."""
+    dtype_name = jnp.dtype(dtype).name
     arrays: dict[str, np.ndarray] = {}
     manifest: dict[str, Any] = {
         "bundle_version": BUNDLE_VERSION,
@@ -227,6 +296,7 @@ def export_bundle(
         "size_map": {k: int(v) for k, v in size_map.items()},
         "step": int(step),
         "dtype": dtype_name,
+        "version": int(version),
     }
     if coll is not None:
         if tables is None or dense_params is None:
@@ -242,19 +312,197 @@ def export_bundle(
     else:
         for k, v in _flatten(params).items():
             arrays[f"params:{k}"] = _store(v, dtype)
-
-    np.savez(out / _ARRAYS, **arrays)
-    (out / _MANIFEST).write_text(json.dumps(manifest, indent=1, sort_keys=True))
-    return out
+    return manifest, arrays
 
 
-def load_bundle(bundle_dir: str | Path) -> ServingBundle:
+def _row_diff(new: np.ndarray, base: np.ndarray) -> np.ndarray:
+    """Boolean [rows] mask of rows whose STORED bytes differ (byte compare,
+    so NaNs and negative zeros diff exactly like the digest sees them)."""
+    a = np.ascontiguousarray(new).view(np.uint8).reshape(new.shape[0], -1)
+    b = np.ascontiguousarray(base).view(np.uint8).reshape(base.shape[0], -1)
+    return np.any(a != b, axis=1)
+
+
+def export_delta(
+    out_dir: str | Path,
+    base_dir: str | Path,
+    *,
+    model: str,
+    embed_dim: int,
+    cat_columns: tuple[str, ...],
+    cont_columns: tuple[str, ...],
+    size_map: Mapping[str, int],
+    step: int,
+    coll: ShardedEmbeddingCollection,
+    tables: Mapping[str, jax.Array],
+    dense_params: Mapping[str, Any],
+    caches: Mapping[str, Any] | None = None,
+    mixed_precision: bool = False,
+    platform: str | None = None,
+    touched: Mapping[str, np.ndarray] | None = None,
+) -> Path:
+    """Export only the rows that changed since the ``base_dir`` bundle.
+
+    The serving-side twin of incremental checkpointing (fbgemm inference
+    model-update idiom; Monolith's minute-level sparse parameter sync, Liu
+    et al. 2022 §3.3): per table, rows whose stored bytes differ from the
+    base ship as ``delta_ids:{name}`` + ``delta_rows:{name}``; dense/backbone
+    arrays that changed ship whole (they are KBs, not GBs).  The manifest is
+    a chain entry — ``version = base + 1``, ``parent_digest``, and the
+    ``result_digest`` the materialized bundle must hash to after
+    :func:`apply_delta_arrays` — so a consumer can refuse gaps, re-orders,
+    and corruption.
+
+    ``touched``: optional per-table row-id hint (the PR-6 cache dirty sets /
+    stream cursors).  The byte diff stays authoritative; a changed row
+    OUTSIDE the hint is a loud error (a stale hint must never ship a stale
+    delta silently).
+    """
+    base_manifest, base_arrays = read_raw_bundle(base_dir)
+    want = base_manifest.get("digest")
+    got = bundle_digest(base_manifest, base_arrays)
+    if want != got:
+        raise ValueError(
+            f"delta base {base_dir}: digest {got} != manifest {want!r} — "
+            "refusing to chain onto a corrupt base")
+    if base_manifest["kind"] != "sparse":
+        raise ValueError(
+            f"delta export needs a sparse base bundle, got kind "
+            f"{base_manifest['kind']!r} (dense bundles re-export whole)")
+    dtype = compute_dtype(mixed_precision, platform)
+    new_manifest, new_arrays = _materialize(
+        model=model, embed_dim=embed_dim, cat_columns=cat_columns,
+        cont_columns=cont_columns, size_map=size_map, step=step, coll=coll,
+        tables=tables, dense_params=dense_params, params=None, caches=caches,
+        dtype=dtype, version=int(base_manifest["version"]) + 1)
+    frozen = ("kind", "model", "embed_dim", "cat_columns", "cont_columns",
+              "size_map", "dtype", "tables")
+    for key in frozen:
+        if new_manifest.get(key) != base_manifest.get(key):
+            raise ValueError(
+                f"delta export schema drift on {key!r}: base "
+                f"{base_manifest.get(key)!r} != new {new_manifest.get(key)!r}"
+                " — a delta cannot change the bundle schema; re-export full")
+    result_digest = bundle_digest(new_manifest, new_arrays)
+
+    delta_arrays: dict[str, np.ndarray] = {}
+    tables_delta: dict[str, int] = {}
+    replaced: list[str] = []
+    for key in sorted(new_arrays):
+        if key.startswith("table:"):
+            name = key.removeprefix("table:")
+            mask = _row_diff(new_arrays[key], base_arrays[key])
+            ids = np.nonzero(mask)[0].astype(np.int64)
+            if touched is not None:
+                hint = np.asarray(touched.get(name, ()), dtype=np.int64)
+                stray = np.setdiff1d(ids, hint)
+                if stray.size:
+                    raise ValueError(
+                        f"delta export: table {name!r} rows {stray[:8].tolist()}"
+                        " changed outside the touched-row hint — the hint is "
+                        "stale; refusing to ship a delta that would miss them")
+            if ids.size:
+                delta_arrays[f"delta_ids:{name}"] = ids
+                delta_arrays[f"delta_rows:{name}"] = np.ascontiguousarray(
+                    new_arrays[key][ids])
+                tables_delta[name] = int(ids.size)
+        elif _row_diff(new_arrays[key].reshape(1, -1),
+                       base_arrays[key].reshape(1, -1))[0]:
+            delta_arrays[key] = new_arrays[key]
+            replaced.append(key)
+
+    delta_manifest: dict[str, Any] = {
+        "bundle_version": BUNDLE_VERSION,
+        "kind": "delta",
+        "base_kind": base_manifest["kind"],
+        "model": model,
+        "step": int(step),
+        "dtype": new_manifest["dtype"],
+        "version": int(base_manifest["version"]) + 1,
+        "parent_version": int(base_manifest["version"]),
+        "parent_digest": base_manifest["digest"],
+        "result_digest": result_digest,
+        "tables_delta": tables_delta,
+        "replaced": replaced,
+    }
+    delta_manifest["digest"] = bundle_digest(delta_manifest, delta_arrays)
+    return write_raw_bundle(out_dir, delta_manifest, delta_arrays)
+
+
+def apply_delta_arrays(
+    base_manifest: Mapping[str, Any],
+    base_arrays: Mapping[str, np.ndarray],
+    delta_manifest: Mapping[str, Any],
+    delta_arrays: Mapping[str, np.ndarray],
+) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
+    """Compose a delta onto its parent: (result manifest, stored arrays).
+
+    Pure chain math (durability is :mod:`tdfo_tpu.serve.swap`'s job).
+    Refuses, with a loud ``ValueError`` naming the cause: a non-delta
+    manifest, a version gap or re-order (``parent_version`` mismatch), a
+    parent whose digest is not the delta's ``parent_digest``, a delta whose
+    own digest does not match its payload, and a composed result that does
+    not hash to ``result_digest``.
+    """
+    if delta_manifest.get("kind") != "delta":
+        raise ValueError(
+            f"not a delta manifest (kind={delta_manifest.get('kind')!r})")
+    if delta_manifest.get("bundle_version") != BUNDLE_VERSION:
+        raise ValueError(
+            f"delta has bundle_version {delta_manifest.get('bundle_version')!r},"
+            f" this build serves {BUNDLE_VERSION}")
+    base_v = int(base_manifest.get("version", 0))
+    parent_v = int(delta_manifest["parent_version"])
+    if parent_v != base_v:
+        raise ValueError(
+            f"delta chain out of order: delta v{delta_manifest['version']} "
+            f"expects parent v{parent_v}, current bundle is v{base_v} — "
+            "deltas apply strictly in version order, no gaps or re-orders")
+    if delta_manifest["parent_digest"] != base_manifest.get("digest"):
+        raise ValueError(
+            f"delta parent digest mismatch: delta expects parent "
+            f"{delta_manifest['parent_digest']}, current bundle digest is "
+            f"{base_manifest.get('digest')!r} — the parent is not the bundle "
+            "this delta was exported against")
+    own = bundle_digest(delta_manifest, delta_arrays)
+    if own != delta_manifest.get("digest"):
+        raise ValueError(
+            f"delta digest mismatch: payload hashes to {own}, manifest says "
+            f"{delta_manifest.get('digest')!r} — corrupt delta")
+
+    out_arrays = {k: v for k, v in base_arrays.items()}
+    for key in delta_manifest.get("replaced", ()):
+        out_arrays[key] = delta_arrays[key]
+    for name in delta_manifest.get("tables_delta", {}):
+        ids = delta_arrays[f"delta_ids:{name}"]
+        rows = delta_arrays[f"delta_rows:{name}"]
+        arr = np.array(base_arrays[f"table:{name}"])
+        arr[ids] = rows
+        out_arrays[f"table:{name}"] = arr
+
+    out_manifest = {k: v for k, v in base_manifest.items() if k != "digest"}
+    out_manifest["step"] = int(delta_manifest["step"])
+    out_manifest["version"] = int(delta_manifest["version"])
+    digest = bundle_digest(out_manifest, out_arrays)
+    if digest != delta_manifest["result_digest"]:
+        raise ValueError(
+            f"delta result digest mismatch: composed bundle hashes to "
+            f"{digest}, delta promises {delta_manifest['result_digest']} — "
+            "refusing to serve an unverified composition")
+    out_manifest["digest"] = digest
+    return out_manifest, out_arrays
+
+
+def load_bundle(bundle_dir: str | Path, *, verify: bool = False) -> ServingBundle:
     """Load and VALIDATE a serving bundle; refuses anything suspect.
 
     Refusal cases (each a ``ValueError`` naming the cause, mirroring the
     training restore discipline): missing manifest, ``bundle_version``
     mismatch, manifest/array key drift, and per-table shape drift — all of
     which could otherwise serve scrambled or stale parameters silently.
+    ``verify=True`` additionally recomputes the content digest over the
+    stored arrays and refuses a mismatch — the swap store's stance
+    (:mod:`tdfo_tpu.serve.swap`) for every bundle it publishes or serves.
     """
     bdir = Path(bundle_dir)
     mpath = bdir / _MANIFEST
@@ -269,7 +517,14 @@ def load_bundle(bundle_dir: str | Path) -> ServingBundle:
             "value-compatible across versions; re-export the checkpoint.")
     dtype_name = manifest["dtype"]
     with np.load(bdir / _ARRAYS) as z:
-        arrays = {k: _load_stored(z[k], dtype_name) for k in z.files}
+        raw = {k: z[k] for k in z.files}
+    if verify:
+        got = bundle_digest(manifest, raw)
+        if got != manifest.get("digest"):
+            raise ValueError(
+                f"serving bundle {bdir}: content digest {got} != manifest "
+                f"{manifest.get('digest')!r} — refusing a corrupt bundle")
+    arrays = {k: _load_stored(v, dtype_name) for k, v in raw.items()}
 
     kind = manifest["kind"]
     tables = dense_params = params = None
@@ -314,4 +569,6 @@ def load_bundle(bundle_dir: str | Path) -> ServingBundle:
         tables=tables,
         dense_params=dense_params,
         params=params,
+        version=int(manifest.get("version", 0)),
+        digest=str(manifest.get("digest", "")),
     )

@@ -14,7 +14,19 @@ serving stacks do (Monolith's serving tier, TF-Serving's batching layer):
     (``tests/test_serve_frontend.py`` pins that count);
   * per-request latency lands in the metrics JSONL via the existing
     :class:`~tdfo_tpu.train.trainer.MetricLogger`, with a p50/p99 summary
-    record at the end — the observability layer the reference lacks.
+    record at the end — the observability layer the reference lacks;
+  * overload sheds instead of queueing unboundedly: with ``max_queue`` set,
+    an arriving request first evicts pending requests already past the
+    batch deadline (oldest first — they would miss their latency bound
+    anyway), then either displaces the oldest survivor
+    (``shed_policy="oldest"``) or bounces itself (``"reject"``); every shed
+    lands in the JSONL with ``outcome="shed"``;
+  * :meth:`MicroBatcher.swap` flips to a new scorer without dropping
+    accepted traffic: in-flight requests drain on the OLD scorer, the flip
+    itself is a host-side reference swap (atomic under the GIL), and the
+    JSONL records swap latency plus per-request ``under_swap`` so
+    p99-under-swap is measurable (torchrec inference model-update
+    analogue; see ``tdfo_tpu/serve/swap.py`` for the on-disk half).
 
 The clock is injectable so deadline behaviour is deterministic under test
 (the fault-injection stance of ``utils/faults.py`` applied to time).
@@ -50,6 +62,9 @@ class MicroBatcher:
         logger=None,
         clock: Callable[[], float] = time.monotonic,
         program_cache_size: Callable[[], int] | None = None,
+        max_queue: int = 0,
+        shed_policy: str = "oldest",
+        watchdog=None,
     ):
         buckets = tuple(buckets)
         if not buckets or list(buckets) != sorted(set(buckets)):
@@ -57,6 +72,12 @@ class MicroBatcher:
         if max_batch > buckets[-1]:
             raise ValueError(
                 f"max_batch {max_batch} does not fit buckets[-1] {buckets[-1]}")
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0 (0 = unbounded), "
+                             f"got {max_queue}")
+        if shed_policy not in ("oldest", "reject"):
+            raise ValueError(f"shed_policy must be 'oldest' or 'reject', "
+                             f"got {shed_policy!r}")
         self._score = score_fn
         self._buckets = buckets
         self._max_batch = int(max_batch)
@@ -67,6 +88,12 @@ class MicroBatcher:
         # test pin): when the scorer exposes its compiled-program count,
         # every ship verifies it stays <= len(buckets)
         self._cache_size = program_cache_size
+        self._max_queue = int(max_queue)  # pending REQUESTS cap, 0 = off
+        self._shed_policy = shed_policy
+        # serving heartbeat: beat per shipped batch so a wedged scorer trips
+        # the same stack-dump path as a wedged train step (obs/watchdog.py)
+        self._watchdog = watchdog
+        self._ships = 0
         self._pending: list[tuple[Any, dict[str, np.ndarray], int, float]] = []
         self._pending_rows = 0
         self.results: dict[Any, np.ndarray] = {}
@@ -75,6 +102,11 @@ class MicroBatcher:
         # the bucket set changes `padded`, the deadline changes when a
         # partial (rows < max_batch) batch ships
         self.shipped: list[tuple[int, int]] = []
+        self.shed: list[tuple[Any, str]] = []  # (request_id, reason)
+        self.swaps: list[dict[str, Any]] = []
+        self._version: Any = None  # bundle chain version being served
+        self._swapping = False
+        self._under_swap_ms: list[float] = []
 
     # ------------------------------------------------------------- intake
 
@@ -89,10 +121,38 @@ class MicroBatcher:
             raise ValueError(
                 f"request {request_id!r} has {n} rows > max_batch "
                 f"{self._max_batch}; split it upstream")
+        if self._max_queue and len(self._pending) >= self._max_queue:
+            # admission control: shed already-doomed requests first (past
+            # the deadline they were promised), then apply the policy
+            now = self._clock()
+            while (self._pending and len(self._pending) >= self._max_queue
+                   and now - self._pending[0][3] >= self._deadline_s):
+                self._shed_oldest("past_deadline")
+            if len(self._pending) >= self._max_queue:
+                if self._shed_policy == "reject":
+                    self._record_shed(request_id, n, self._clock(), "rejected")
+                    return
+                self._shed_oldest("displaced")
         self._pending.append((request_id, cols, n, self._clock()))
         self._pending_rows += n
         while self._pending_rows >= self._max_batch:
             self._ship()
+
+    def _shed_oldest(self, reason: str) -> None:
+        rid, _, n, t0 = self._pending.pop(0)
+        self._pending_rows -= n
+        self._record_shed(rid, n, t0, reason)
+
+    def _record_shed(self, rid: Any, n: int, t0: float, reason: str) -> None:
+        self.results[rid] = None  # the caller sees the outcome, not a KeyError
+        self.shed.append((rid, reason))
+        if self._logger is not None:
+            self._logger.log(event="serve_request", request=str(rid), rows=n,
+                             batch_rows=0, padded=0, queue_depth=len(self._pending),
+                             batch_fill=0.0,
+                             latency_ms=(self._clock() - t0) * 1000.0,
+                             outcome="shed", shed_reason=reason,
+                             under_swap=self._swapping, version=self._version)
 
     def poll(self) -> None:
         """Ship a PARTIAL batch iff the oldest pending request's deadline
@@ -133,8 +193,16 @@ class MicroBatcher:
             col = np.concatenate([cols[k] for _, cols, _, _ in take])
             batch[k] = np.pad(col, [(0, padded - rows)] +
                               [(0, 0)] * (col.ndim - 1))
+        from tdfo_tpu.utils import faults
+
+        inj = faults.active()
+        if inj is not None:
+            inj.maybe_slow_score()  # deterministic wedged-scorer stand-in
         scores = np.asarray(self._score(batch))[:rows]
         self.shipped.append((rows, padded))
+        self._ships += 1
+        if self._watchdog is not None:
+            self._watchdog.beat(self._ships)
         if self._cache_size is not None:
             n_progs = self._cache_size()
             if n_progs > len(self._buckets):
@@ -153,11 +221,50 @@ class MicroBatcher:
             off += n
             latency_ms = (done - t0) * 1000.0
             self.latencies_ms.append(latency_ms)
+            if self._swapping:
+                self._under_swap_ms.append(latency_ms)
             if self._logger is not None:
                 self._logger.log(event="serve_request", request=str(rid),
                                  rows=n, batch_rows=rows, padded=padded,
                                  queue_depth=depth, batch_fill=fill,
-                                 latency_ms=latency_ms)
+                                 latency_ms=latency_ms, outcome="ok",
+                                 under_swap=self._swapping,
+                                 version=self._version)
+
+    # ------------------------------------------------------------ hot swap
+
+    def swap(self, score_fn: Callable, *, version: Any = None,
+             program_cache_size: Callable[[], int] | None = None) -> float:
+        """Flip to a new scorer without dropping accepted traffic.
+
+        In-flight requests drain on the OLD scorer (they were admitted
+        against its latency promise), then the function reference flips —
+        atomic under the GIL, so the next ship sees exactly one scorer.
+        Requests served inside the drain window are tagged ``under_swap``
+        in the JSONL and feed ``p99_under_swap_ms``.  Returns the swap
+        latency in ms (also logged as a ``serve_swap`` event).  The durable
+        on-disk half (verify + publish + crash recovery) lives in
+        :class:`tdfo_tpu.serve.swap.BundleStore`.
+        """
+        t0 = self._clock()
+        drained = self._pending_rows
+        self._swapping = True
+        try:
+            self.drain()
+        finally:
+            self._swapping = False
+        self._score = score_fn
+        # the old scorer's program-cache probe is stale the moment we flip
+        self._cache_size = program_cache_size
+        old_version, self._version = self._version, version
+        swap_ms = (self._clock() - t0) * 1000.0
+        self.swaps.append({"version": version, "from_version": old_version,
+                           "drained_rows": drained, "swap_ms": swap_ms})
+        if self._logger is not None:
+            self._logger.log(event="serve_swap", version=version,
+                             from_version=old_version, drained_rows=drained,
+                             swap_ms=swap_ms)
+        return swap_ms
 
     # -------------------------------------------------------------- stats
 
@@ -177,7 +284,12 @@ class MicroBatcher:
             "batches": len(self.shipped),
             "p50_ms": float(np.percentile(lat, 50)) if lat.size else 0.0,
             "p99_ms": float(np.percentile(lat, 99)) if lat.size else 0.0,
+            "shed": len(self.shed),
+            "swaps": len(self.swaps),
         }
+        if self._under_swap_ms:
+            out["p99_under_swap_ms"] = float(
+                np.percentile(np.asarray(self._under_swap_ms, np.float64), 99))
         if self._logger is not None and lat.size:
             self._logger.log(event="serve_summary", **out)
         return out
@@ -239,13 +351,26 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
             batch[c] = rng.random(n, dtype=np.float32)
         requests.append((f"req{i}", batch))
 
+    watchdog = None
+    if config.telemetry.stall_timeout_s > 0:
+        from tdfo_tpu.obs.watchdog import StallWatchdog
+
+        watchdog = StallWatchdog(
+            Path(log_dir or config.checkpoint_dir or ".")
+            / "heartbeat_serve.jsonl",
+            config.telemetry.stall_timeout_s, label="serve").start()
+
     t0 = time.monotonic()
     mb = MicroBatcher(
         scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
         batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
-        program_cache_size=scorer.score_cache_size)
+        program_cache_size=scorer.score_cache_size,
+        max_queue=spec.max_queue, shed_policy=spec.shed_policy,
+        watchdog=watchdog)
     mb.run(requests)
     wall = time.monotonic() - t0
+    if watchdog is not None:
+        watchdog.stop()
     stats = mb.stats()
     stats["qps"] = stats["requests"] / wall if wall > 0 else float("inf")
     stats["programs"] = scorer.score_cache_size()

@@ -1,0 +1,419 @@
+"""Crash-safe bundle store + hot-swap orchestration for the serving frontend.
+
+The training side survives preemption via checkpoint layout stamps and
+atomic cursor sidecars (``train/checkpoint.py``); this module is the
+serving twin.  The reference analogue is torchrec's inference model-update
+idiom (``DistributedModelParallel`` state-dict reload into a live predictor;
+fbgemm's inplace-update path for TBE weights) and Monolith's minute-level
+sparse sync (Liu et al. 2022 §3.3) — a frontend must pick up a newer model
+without dropping traffic, and must survive crashing at ANY byte of the
+update.
+
+Layout under the store root::
+
+    versions/v000000/   fully-materialized serving bundles (bundle.json +
+    versions/v000001/   arrays.npz), published by directory rename
+    CURRENT             {"version": N, "digest": ...} pointer, atomic JSON
+    quarantine.json     record of refused-corrupt deltas (never re-tried)
+
+Durability discipline — the ONLY sanctioned rename sites in the repo
+(``test_quality.py`` rejects bare ``os.rename``/``os.replace`` elsewhere):
+
+  * :func:`atomic_write_json` — write-temp + fsync + ``os.replace`` +
+    parent-dir fsync, for the ``CURRENT`` pointer and quarantine record;
+  * :func:`publish_dir` — stage a complete bundle directory under a
+    ``.tmp`` name, fsync every file and the directory, then one rename.
+
+A crash between stage and publish leaves only a ``*.tmp`` directory;
+:meth:`BundleStore.recover` deletes strays and re-points ``CURRENT`` at the
+newest version whose content digest verifies — so "restart the same
+command" converges, exactly like the trainer's kill-marker semantics.
+
+Failure degradation: a delta whose payload does not hash to its manifest
+digest is QUARANTINED (recorded, never applied, never crashes the
+frontend); the store keeps serving the last good version.  After
+``max_bad_deltas`` consecutive quarantines the controller flips a degraded
+flag into the serving heartbeat (``obs/watchdog.py set_status``) — the
+operator signal that the export pipeline, not the frontend, is sick.  All
+of it is driven deterministically by the ``[faults]`` harness
+(``corrupt_delta_nth``, ``kill_during_swap``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from tdfo_tpu.serve.export import (
+    apply_delta_arrays,
+    bundle_digest,
+    read_raw_bundle,
+    write_raw_bundle,
+)
+from tdfo_tpu.utils import faults
+from tdfo_tpu.utils.retry import retry_call
+
+__all__ = [
+    "BundleStore",
+    "CorruptDeltaError",
+    "DeltaChainError",
+    "DeltaPoller",
+    "SwapController",
+    "atomic_write_json",
+    "publish_dir",
+]
+
+_CURRENT = "CURRENT"
+_QUARANTINE = "quarantine.json"
+
+
+class DeltaChainError(ValueError):
+    """The delta does not extend the current chain head (gap, re-order, or
+    parent digest mismatch) — a loud refusal, never applied."""
+
+
+class CorruptDeltaError(ValueError):
+    """The delta payload fails digest verification — quarantined, the last
+    good version keeps serving."""
+
+
+# ------------------------------------------------------- atomic primitives
+
+
+def _fsync_dir(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_json(path: str | Path, obj: Any) -> None:
+    """The blessed pointer-file writer: temp in the same directory, fsync,
+    ``os.replace`` (atomic on POSIX), fsync the directory so the rename
+    itself is durable.  A reader sees the old complete file or the new
+    complete file, never a torn one."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w") as f:
+        f.write(json.dumps(obj, indent=1, sort_keys=True))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    _fsync_dir(path.parent)
+
+
+def publish_dir(staged: str | Path, final: str | Path) -> None:
+    """The blessed directory publisher: fsync every file in the staged
+    directory (its contents were written by ordinary buffered I/O), fsync
+    the directory, then ONE rename to the final name.  Readers discover
+    bundles by final name only, so a half-written bundle is unreachable."""
+    staged, final = Path(staged), Path(final)
+    for p in sorted(staged.rglob("*")):
+        if p.is_file():
+            fd = os.open(p, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+    _fsync_dir(staged)
+    os.replace(staged, final)
+    _fsync_dir(final.parent)
+
+
+# --------------------------------------------------------------- the store
+
+
+def _version_name(version: int) -> str:
+    return f"v{version:06d}"
+
+
+class BundleStore:
+    """Versioned, digest-verified bundle store with an atomic CURRENT pointer.
+
+    Every bundle directory under ``versions/`` is fully materialized (deltas
+    are composed at ingest, not at serve time), so recovery never needs to
+    re-walk a chain: the newest directory whose digest verifies IS the last
+    fully-verified version.
+    """
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.versions = self.root / "versions"
+        self.versions.mkdir(parents=True, exist_ok=True)
+
+    # ------------------------------------------------------------ queries
+
+    def current_version(self) -> int | None:
+        cur = self.root / _CURRENT
+        if not cur.exists():
+            return None
+        return int(json.loads(cur.read_text())["version"])
+
+    def current_dir(self) -> Path | None:
+        v = self.current_version()
+        return None if v is None else self.versions / _version_name(v)
+
+    def quarantined(self) -> list[dict]:
+        qpath = self.root / _QUARANTINE
+        return json.loads(qpath.read_text()) if qpath.exists() else []
+
+    def _read_current(self) -> tuple[dict, dict[str, np.ndarray]]:
+        cdir = self.current_dir()
+        if cdir is None:
+            raise ValueError(f"bundle store {self.root} has no CURRENT version")
+        return retry_call(read_raw_bundle, cdir,
+                          description=f"bundle read {cdir.name}")
+
+    # ------------------------------------------------------------- writes
+
+    def _publish(self, manifest: dict, arrays: dict[str, np.ndarray],
+                 version: int, *, is_swap: bool = False) -> Path:
+        final = self.versions / _version_name(version)
+        if final.exists():
+            raise ValueError(
+                f"bundle store already holds {final.name} — versions are "
+                "immutable once published")
+        staged = self.versions / (_version_name(version) + ".tmp")
+        if staged.exists():
+            shutil.rmtree(staged)  # leftover from a crashed apply
+        write_raw_bundle(staged, manifest, arrays)
+        inj = faults.active()
+        if is_swap and inj is not None:
+            inj.maybe_kill_swap()  # the canonical half-applied crash point
+        publish_dir(staged, final)
+        atomic_write_json(self.root / _CURRENT,
+                          {"version": version, "digest": manifest["digest"]})
+        return final
+
+    def ingest_full(self, bundle_dir: str | Path) -> int:
+        """Verify and publish a FULL bundle (chain head / chain reset).
+
+        Refuses a digest-corrupt bundle and a version that does not advance
+        the store (re-ingesting the head is idempotent-by-refusal, not
+        silent overwrite)."""
+        manifest, arrays = retry_call(
+            read_raw_bundle, bundle_dir,
+            description=f"full bundle read {Path(bundle_dir).name}")
+        got = bundle_digest(manifest, arrays)
+        if got != manifest.get("digest"):
+            raise ValueError(
+                f"full bundle {bundle_dir}: digest {got} != manifest "
+                f"{manifest.get('digest')!r} — refusing a corrupt bundle")
+        if manifest.get("kind") == "delta":
+            raise ValueError(
+                f"{bundle_dir} is a delta, not a full bundle — deltas go "
+                "through apply_delta against the current version")
+        version = int(manifest.get("version", 0))
+        cur = self.current_version()
+        if cur is not None and version <= cur:
+            raise ValueError(
+                f"full bundle {bundle_dir} is v{version}, store already "
+                f"serves v{cur} — stale full export refused")
+        self._publish(manifest, arrays, version)
+        return version
+
+    def apply_delta(self, delta_dir: str | Path) -> int:
+        """Compose a delta onto CURRENT and publish the result atomically.
+
+        Chain violations (gap / re-order / wrong parent) raise
+        :class:`DeltaChainError`; payload corruption raises
+        :class:`CorruptDeltaError` (the caller quarantines).  Either way
+        CURRENT is untouched until the composed bundle is fully staged,
+        fsynced, published, and digest-verified.
+        """
+        delta_dir = Path(delta_dir)
+        dmanifest, darrays = retry_call(
+            read_raw_bundle, delta_dir,
+            description=f"delta read {delta_dir.name}")
+        inj = faults.active()
+        if inj is not None and inj.corrupt_delta_due():
+            # bit-flip the payload IN MEMORY so digest verification runs
+            # against real corruption, not a mocked exception
+            if darrays:
+                k = sorted(darrays)[0]
+                a = np.array(darrays[k])
+                a.view(np.uint8).reshape(-1)[0] ^= 0xFF
+                darrays = dict(darrays, **{k: a})
+            else:
+                dmanifest = dict(dmanifest, digest="0" * 16)
+        if dmanifest.get("kind") != "delta":
+            raise DeltaChainError(
+                f"{delta_dir} is not a delta (kind={dmanifest.get('kind')!r})")
+        own = bundle_digest(dmanifest, darrays)
+        if own != dmanifest.get("digest"):
+            raise CorruptDeltaError(
+                f"delta {delta_dir.name}: payload hashes to {own}, manifest "
+                f"says {dmanifest.get('digest')!r} — corrupt delta")
+        base_manifest, base_arrays = self._read_current()
+        # verify the served base's ACTUAL bytes, not just its manifest field:
+        # a delta that happens to rewrite the torn rows would otherwise
+        # launder parent corruption into a result whose digest verifies
+        base_got = bundle_digest(base_manifest, base_arrays)
+        if base_got != base_manifest.get("digest"):
+            raise CorruptDeltaError(
+                f"serving base v{base_manifest.get('version')}: payload "
+                f"hashes to {base_got}, manifest says "
+                f"{base_manifest.get('digest')!r} — corrupt base, refusing "
+                "to compose")
+        try:
+            manifest, arrays = apply_delta_arrays(
+                base_manifest, base_arrays, dmanifest, darrays)
+        except ValueError as e:
+            msg = str(e)
+            if "out of order" in msg or "parent digest" in msg:
+                raise DeltaChainError(msg) from e
+            raise CorruptDeltaError(msg) from e
+        self._publish(manifest, arrays, int(manifest["version"]), is_swap=True)
+        return int(manifest["version"])
+
+    # ----------------------------------------------------------- recovery
+
+    def recover(self) -> int | None:
+        """Restart-after-crash entry point: delete stray ``*.tmp`` staging
+        directories, walk published versions newest-first, and point CURRENT
+        at the first one whose content digest verifies (pruning any newer
+        corrupt/torn directory).  Returns the recovered version, or ``None``
+        for an empty store."""
+        for stray in self.versions.glob("*.tmp"):
+            shutil.rmtree(stray)
+        best: tuple[int, dict] | None = None
+        for vdir in sorted(self.versions.iterdir(), reverse=True):
+            if not vdir.is_dir():
+                continue
+            try:
+                manifest, arrays = read_raw_bundle(vdir)
+                if bundle_digest(manifest, arrays) != manifest.get("digest"):
+                    raise ValueError("digest mismatch")
+                best = (int(manifest["version"]), manifest)
+                break
+            except Exception:
+                # torn/corrupt directory: unreachable once CURRENT skips it
+                shutil.rmtree(vdir)
+        if best is None:
+            cur = self.root / _CURRENT
+            if cur.exists():
+                cur.unlink()
+            return None
+        version, manifest = best
+        atomic_write_json(self.root / _CURRENT,
+                          {"version": version, "digest": manifest["digest"]})
+        return version
+
+    def record_quarantine(self, delta_dir: str | Path, error: str) -> None:
+        rec = {"path": str(delta_dir), "error": error, "time": time.time()}
+        atomic_write_json(self.root / _QUARANTINE, self.quarantined() + [rec])
+
+
+# ------------------------------------------------------------ orchestration
+
+
+class DeltaPoller:
+    """Cadence gate + chain-directory discovery for the serving loop.
+
+    The exporter drops chain entries next to each other
+    (``<chain_root>/v000001`` …); the poller checks for the successor of the
+    store's current version at most once per ``poll_s`` (the ``[serving]
+    swap_poll_s`` knob), injectable clock for tests."""
+
+    def __init__(self, chain_root: str | Path, *, poll_s: float = 1.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.chain_root = Path(chain_root)
+        self.poll_s = float(poll_s)
+        self._clock = clock
+        self._next = self._clock()  # first poll is due immediately
+
+    def due(self) -> bool:
+        now = self._clock()
+        if now < self._next:
+            return False
+        self._next = now + self.poll_s
+        return True
+
+    def next_delta(self, current_version: int) -> Path | None:
+        cand = self.chain_root / _version_name(current_version + 1)
+        return cand if (cand / "bundle.json").exists() else None
+
+
+class SwapController:
+    """Drives the store + MicroBatcher through verified hot-swaps, absorbing
+    corrupt deltas into quarantine and surfacing degraded mode.
+
+    ``build_score_fn(bundle_dir) -> score_fn`` rebuilds the scorer from a
+    published bundle directory (typically ``make_scorer(load_bundle(d,
+    verify=True))``); the controller never lets a failed rebuild take down
+    the frontend — the old scorer keeps serving.
+    """
+
+    def __init__(self, store: BundleStore,
+                 build_score_fn: Callable[[Path], Callable],
+                 batcher=None, *, max_bad_deltas: int = 3,
+                 logger=None, watchdog=None):
+        if max_bad_deltas < 1:
+            raise ValueError(f"max_bad_deltas must be >= 1, got {max_bad_deltas}")
+        self.store = store
+        self.build_score_fn = build_score_fn
+        self.batcher = batcher
+        self.max_bad_deltas = int(max_bad_deltas)
+        self.logger = logger
+        self.watchdog = watchdog
+        self.consecutive_bad = 0
+        self.degraded = False
+
+    def _log(self, **rec) -> None:
+        if self.logger is not None:
+            self.logger.log(**rec)
+
+    def _set_degraded(self, flag: bool) -> None:
+        if flag != self.degraded:
+            self.degraded = flag
+            self._log(event="serving_degraded", degraded=flag,
+                      bad_deltas=self.consecutive_bad)
+        if self.watchdog is not None:
+            self.watchdog.set_status(degraded=self.degraded,
+                                     bad_deltas=self.consecutive_bad)
+
+    def apply(self, delta_dir: str | Path) -> bool:
+        """Apply one delta end to end: verify + compose + publish + rebuild
+        scorer + drain-and-flip the batcher.  Returns True on a completed
+        swap; False when the delta was quarantined (still serving the last
+        good version).  Chain violations raise — a gap or re-order is an
+        exporter-side bug the frontend must not paper over."""
+        try:
+            version = self.store.apply_delta(delta_dir)
+        except CorruptDeltaError as e:
+            self.store.record_quarantine(delta_dir, str(e))
+            self.consecutive_bad += 1
+            self._log(event="delta_quarantined", path=str(delta_dir),
+                      error=str(e), consecutive_bad=self.consecutive_bad)
+            self._set_degraded(self.consecutive_bad >= self.max_bad_deltas)
+            return False
+        score_fn = retry_call(
+            self.build_score_fn, self.store.current_dir(),
+            description=f"scorer rebuild v{version}")
+        if self.batcher is not None:
+            self.batcher.swap(score_fn, version=version)
+        self.consecutive_bad = 0
+        self._set_degraded(False)
+        return True
+
+    def poll(self, poller: DeltaPoller) -> bool:
+        """One serving-loop tick: when the poller is due and the chain has a
+        successor delta, apply it.  Returns True when a swap completed."""
+        if not poller.due():
+            return False
+        cur = self.store.current_version()
+        if cur is None:
+            return False
+        nxt = poller.next_delta(cur)
+        if nxt is None:
+            return False
+        if any(q["path"] == str(nxt) for q in self.store.quarantined()):
+            return False  # quarantined deltas are never re-tried by polling
+        return self.apply(nxt)

@@ -23,10 +23,26 @@ the retry layer consult:
     (``tdfo_tpu/obs/watchdog.py``) is testable end-to-end.  State evolution
     is untouched — the stall is pure host-side latency.
 
-All triggers key on run-global DATA position (batches consumed), which is
-monotone across rollbacks and resumes — ``state.step`` is not (rollback
-rewinds it).  Zero disables a trigger; a process with no faults configured
-pays a single ``is None`` check per site.
+The serving-side triggers (consulted by ``tdfo_tpu/serve/swap.py`` and the
+MicroBatcher) key on OPERATION counts rather than data steps:
+
+  * ``corrupt_delta_nth = N``  — the Nth delta bundle the swap store reads
+    has its payload bit-flipped in memory (once), so the digest-verification
+    + quarantine + fall-back-to-last-good path runs against a REAL corrupt
+    payload, not a mocked error.
+  * ``slow_score_ms = M``  — every shipped scoring batch sleeps M ms on the
+    host, a deterministic wedged-scorer stand-in driving the serving
+    heartbeat/stall path.
+  * ``kill_during_swap = N``  — hard-kill (``os._exit(17)``) in the middle
+    of the Nth hot-swap apply, AFTER the composed bundle is staged but
+    BEFORE it is published — the canonical half-applied state the restart
+    recovery must survive.  One-shot per workdir via a
+    ``faults_swap_kill.marker`` sentinel, like ``kill_at_step``.
+
+All training triggers key on run-global DATA position (batches consumed),
+which is monotone across rollbacks and resumes — ``state.step`` is not
+(rollback rewinds it).  Zero disables a trigger; a process with no faults
+configured pays a single ``is None`` check per site.
 """
 
 from __future__ import annotations
@@ -42,28 +58,35 @@ __all__ = ["FaultSpec", "FaultInjector", "configure", "active", "KILL_EXIT_CODE"
 
 KILL_EXIT_CODE = 17  # distinguishes an injected kill from real crashes
 _MARKER = "faults_kill.marker"
+_SWAP_MARKER = "faults_swap_kill.marker"
 
 
 @dataclass(frozen=True)
 class FaultSpec:
     """The ``[faults]`` config section.  All steps are 1-based run-global
-    data steps; 0 disables."""
+    data steps; serving triggers are 1-based operation counts; 0 disables."""
 
     kill_at_step: int = 0
     nan_at_step: int = 0
     fail_io_nth: int = 0
     stall_at_step: int = 0
     stall_seconds: float = 0.0
+    corrupt_delta_nth: int = 0
+    slow_score_ms: float = 0.0
+    kill_during_swap: int = 0
 
     def __post_init__(self) -> None:
         for name in ("kill_at_step", "nan_at_step", "fail_io_nth",
-                     "stall_at_step", "stall_seconds"):
+                     "stall_at_step", "stall_seconds", "corrupt_delta_nth",
+                     "slow_score_ms", "kill_during_swap"):
             if getattr(self, name) < 0:
                 raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
 
     def any(self) -> bool:
         return bool(self.kill_at_step or self.nan_at_step
-                    or self.fail_io_nth or self.stall_at_step)
+                    or self.fail_io_nth or self.stall_at_step
+                    or self.corrupt_delta_nth or self.slow_score_ms
+                    or self.kill_during_swap)
 
 
 class FaultInjector:
@@ -75,6 +98,9 @@ class FaultInjector:
         self._io_count = 0
         self._io_fired = False
         self._stall_fired = False
+        self._delta_count = 0
+        self._delta_fired = False
+        self._swap_count = 0
 
     # ------------------------------------------------------------- kill
 
@@ -138,6 +164,52 @@ class FaultInjector:
         print(f"[faults] injected {self.spec.stall_seconds:.1f}s stall at "
               f"global step {global_step}", flush=True)
         time.sleep(self.spec.stall_seconds)
+
+    # ----------------------------------------------------------- serving
+
+    def corrupt_delta_due(self) -> bool:
+        """Called by the swap store once per delta payload it reads.  True
+        exactly once, on the configured Nth read — the caller then bit-flips
+        the in-memory payload so digest verification sees REAL corruption."""
+        if not self.spec.corrupt_delta_nth or self._delta_fired:
+            return False
+        self._delta_count += 1
+        if self._delta_count == self.spec.corrupt_delta_nth:
+            self._delta_fired = True
+            print(f"[faults] corrupting delta read #{self._delta_count}",
+                  flush=True)
+            return True
+        return False
+
+    def maybe_slow_score(self) -> None:
+        """Sleep ``slow_score_ms`` on every shipped scoring batch — a
+        deterministic wedged-scorer stand-in for the serving heartbeat."""
+        if self.spec.slow_score_ms:
+            time.sleep(self.spec.slow_score_ms / 1000.0)
+
+    def swap_kill_due(self) -> bool:
+        """True when the mid-swap kill should fire on THIS apply (counts
+        applies; honours the one-shot marker); does NOT exit."""
+        if not self.spec.kill_during_swap:
+            return False
+        if self.workdir is not None and (self.workdir / _SWAP_MARKER).exists():
+            return False
+        self._swap_count += 1
+        return self._swap_count == self.spec.kill_during_swap
+
+    def maybe_kill_swap(self) -> None:
+        """Hard-exit mid-apply (staged, not yet published) when due — the
+        restart must recover to the last fully-verified version."""
+        if not self.swap_kill_due():
+            return
+        if self.workdir is not None:
+            self.workdir.mkdir(parents=True, exist_ok=True)
+            (self.workdir / _SWAP_MARKER).write_text(
+                f"killed during swap apply #{self._swap_count} at {time.time()}\n"
+            )
+        print(f"[faults] injected kill during swap apply #{self._swap_count}",
+              flush=True)
+        os._exit(KILL_EXIT_CODE)
 
     # --------------------------------------------------------------- io
 

@@ -26,7 +26,8 @@ import time
 from pathlib import Path
 from typing import Any, Callable, Iterable
 
-__all__ = ["retry_call", "retryable", "set_failure_log", "recent_failures"]
+__all__ = ["backoff_delay", "retry_call", "retryable", "set_failure_log",
+           "recent_failures"]
 
 # last N failure records, observable by tests and post-mortems even when no
 # log file is configured
@@ -55,6 +56,27 @@ def _record(rec: dict[str, Any]) -> None:
                 f.write(json.dumps(rec) + "\n")
         except OSError:
             pass  # the failure log must never turn a retry into a crash
+
+
+def backoff_delay(
+    attempt: int,
+    *,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    rng: random.Random | None = None,
+) -> float:
+    """Jittered exponential backoff: ``base_delay * 2**attempt`` capped at
+    ``max_delay``, then spread by up to ``jitter`` fraction (full-jitter-lite;
+    the cap applies BEFORE jitter, so the worst case is
+    ``max_delay * (1 + jitter)``).  ``rng`` is injectable so tests pin the
+    draw; ``attempt`` is 0-based.  The single backoff law for the repo —
+    checkpoint I/O and serving bundle loads both go through here via
+    :func:`retry_call`."""
+    if attempt < 0:
+        raise ValueError(f"attempt must be >= 0, got {attempt}")
+    delay = min(base_delay * (2 ** attempt), max_delay)
+    return delay * (1.0 + jitter * (rng or random.Random()).random())
 
 
 def retry_call(
@@ -97,8 +119,9 @@ def retry_call(
             final = attempt == attempts - 1
             delay = 0.0
             if not final:
-                delay = min(base_delay * (2 ** attempt), max_delay)
-                delay *= 1.0 + jitter * rng.random()
+                delay = backoff_delay(attempt, base_delay=base_delay,
+                                      max_delay=max_delay, jitter=jitter,
+                                      rng=rng)
             _record({
                 "time": time.time(),
                 "description": description,

@@ -1,0 +1,64 @@
+"""Worker for the kill-during-swap test (run as a subprocess, NOT pytest).
+
+Usage:
+    python swap_worker.py <root>
+
+``<root>`` is prepared by the parent test and holds ``full_v0/``,
+``delta_v1/``, ``full_v1/`` (the fresh full export of the same state the
+delta reaches) and ``batch.npz``.  Both runs execute the SAME code — the
+restart-converges contract of ``utils/faults.py``:
+
+  * run 1: ``kill_during_swap=1`` is armed, so ``apply_delta`` stages the
+    composed v1 bundle, then dies via ``os._exit(17)`` before publishing —
+    exactly a frontend crash mid-swap.
+  * run 2: the one-shot marker disarms the kill; ``recover()`` cleans the
+    stray staging dir and re-points CURRENT at the last verified version
+    (v0), the delta re-applies, and the worker asserts the composed bundle
+    AND its served logits are bitwise-equal to the fresh full export,
+    printing a JSON verdict for the parent.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+
+
+def main() -> None:
+    root = Path(sys.argv[1])
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    from tdfo_tpu.serve.export import load_bundle, read_raw_bundle
+    from tdfo_tpu.serve.scoring import make_scorer
+    from tdfo_tpu.serve.swap import BundleStore
+    from tdfo_tpu.utils.faults import FaultSpec, configure
+
+    configure(FaultSpec(kill_during_swap=1), workdir=root)
+    store = BundleStore(root / "store")
+    recovered = store.recover()
+    if store.current_version() is None:
+        store.ingest_full(root / "full_v0")
+    version = store.apply_delta(root / "delta_v1")  # run 1 dies in here
+
+    m_store, a_store = read_raw_bundle(store.current_dir())
+    m_fresh, a_fresh = read_raw_bundle(root / "full_v1")
+    assert m_store["digest"] == m_fresh["digest"], "composed != fresh export"
+    for k in a_fresh:
+        assert np.array_equal(a_store[k], a_fresh[k]), f"array drift: {k}"
+
+    batch = {k: v for k, v in np.load(root / "batch.npz").items()}
+    composed = make_scorer(load_bundle(store.current_dir(), verify=True))
+    fresh = make_scorer(load_bundle(root / "full_v1", verify=True))
+    got = np.asarray(composed.score(batch))
+    want = np.asarray(fresh.score(batch))
+    assert np.array_equal(got, want), "served logits drifted from fresh export"
+
+    print(json.dumps({"recovered": recovered, "version": version, "ok": True}))
+
+
+if __name__ == "__main__":
+    main()

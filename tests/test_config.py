@@ -427,3 +427,71 @@ def test_planner_knob_validation():
     # valid combinations construct fine
     Config(model="dlrm", planner=plan)
     Config(model="twotower", model_parallel=True, planner=plan)
+
+
+def test_serving_resilience_knobs(tmp_path: Path):
+    """[serving] max_queue/shed_policy/swap_poll_s/max_bad_deltas: defaults,
+    toml round-trip, rejections, and observable semantics for each."""
+    import numpy as np
+
+    from tdfo_tpu.core.config import ServingSpec
+    from tdfo_tpu.serve.frontend import MicroBatcher
+    from tdfo_tpu.serve.swap import DeltaPoller, SwapController
+
+    cfg = read_configs()
+    assert cfg.serving.max_queue == 0  # unbounded by default
+    assert cfg.serving.shed_policy == "oldest"
+    assert cfg.serving.swap_poll_s == 1.0
+    assert cfg.serving.max_bad_deltas == 3
+
+    (tmp_path / "config.toml").write_text(
+        "[serving]\nmax_queue = 4\nshed_policy = \"reject\"\n"
+        "swap_poll_s = 0.25\nmax_bad_deltas = 1\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.serving.max_queue == 4
+    assert cfg.serving.shed_policy == "reject"
+    assert cfg.serving.swap_poll_s == 0.25
+    assert cfg.serving.max_bad_deltas == 1
+
+    for bad, match in (
+        (dict(max_queue=-1), "max_queue"),
+        (dict(shed_policy="drop-newest"), "shed_policy"),
+        (dict(swap_poll_s=-0.5), "swap_poll_s"),
+        (dict(max_bad_deltas=0), "max_bad_deltas"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(serving=ServingSpec(**bad))
+
+    # each knob is observable through the component it parameterizes:
+    # max_queue bounds admissions, shed_policy picks the victim
+    score = lambda b: np.asarray(b["x"], np.float32)  # noqa: E731
+    for policy, victim in (("oldest", "r0"), ("reject", "r2")):
+        mb = MicroBatcher(score, buckets=(8,), max_batch=8,
+                          batch_deadline_ms=1e9, clock=lambda: 0.0,
+                          max_queue=2, shed_policy=policy)
+        for i in range(3):
+            mb.submit(f"r{i}", {"x": np.arange(1)})
+        assert [rid for rid, _ in mb.shed] == [victim]
+
+    # swap_poll_s is the poll cadence
+    now = [0.0]
+    p = DeltaPoller(tmp_path, poll_s=0.25, clock=lambda: now[0])
+    assert p.due() and not p.due()
+    now[0] = 0.25
+    assert p.due()
+
+    # max_bad_deltas is the degraded-mode threshold
+    class _Store:
+        def record_quarantine(self, *a):
+            pass
+
+        def apply_delta(self, d):
+            from tdfo_tpu.serve.swap import CorruptDeltaError
+
+            raise CorruptDeltaError("corrupt delta")
+
+    for threshold, after_one in ((1, True), (2, False)):
+        ctrl = SwapController(_Store(), lambda d: None,
+                              max_bad_deltas=threshold)
+        assert ctrl.apply(tmp_path / "d") is False
+        assert ctrl.degraded is after_one

@@ -287,3 +287,116 @@ def test_no_precisionless_dots_in_kernel_code():
         "dot_general/dot without explicit precision= in kernel code "
         "(default precision runs bf16 passes on f32 operands): "
         + ", ".join(offenders))
+
+
+def test_no_bare_renames_outside_atomic_swap_helpers():
+    """Crash safety is only as strong as its narrowest rename: a bare
+    ``os.rename``/``os.replace`` (or keywordless ``.rename()`` method call —
+    the ``Path.rename`` shape) outside the blessed helpers skips the
+    fsync-file + replace + fsync-dir discipline, and a crash at that site
+    leaves a torn pointer or a half-published bundle
+    (``tdfo_tpu/serve/swap.py`` docstring).  The ONLY sanctioned sites are
+    ``atomic_write_json`` and ``publish_dir`` there.  Keyworded ``.rename``
+    calls (pandas column renames) are host-side and exempt."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    SANCTIONED = {("serve/swap.py", "atomic_write_json"),
+                  ("serve/swap.py", "publish_dir")}
+
+    offenders, sanctioned_hits = [], 0
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        tree = ast.parse(path.read_text(), filename=str(path))
+        parents = {}
+        for node in ast.walk(tree):
+            for ch in ast.iter_child_nodes(node):
+                parents[ch] = node
+
+        def enclosing_funcs(node):
+            out = []
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(node.name)
+            return out
+
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            f = node.func
+            is_os_rename = (f.attr in ("rename", "replace")
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id == "os")
+            is_method_rename = (f.attr == "rename"
+                                and not is_os_rename
+                                and not node.keywords)
+            if not (is_os_rename or is_method_rename):
+                continue
+            if any((rel, fn) in SANCTIONED for fn in enclosing_funcs(node)):
+                sanctioned_hits += 1
+                continue
+            offenders.append(f"{path}:{node.lineno}")
+    assert sanctioned_hits >= 2  # the scanner sees both blessed helpers
+    assert not offenders, (
+        "bare rename outside serve/swap.py's atomic helpers (not crash-"
+        "safe — route through atomic_write_json/publish_dir): "
+        + ", ".join(offenders))
+
+
+def test_no_hand_rolled_retry_sleep_loops():
+    """``utils/retry.py`` is the single backoff law (bounded attempts,
+    jittered exponential delay, JSONL failure records, fault-injection
+    hook).  A hand-rolled ``while/for + try + time.sleep`` retry loop
+    anywhere else dodges all four — silent unbounded retries are how a
+    wedged job burns a TPU reservation.  The detector flags any
+    ``time.sleep`` call lexically inside a loop that also contains a
+    ``try`` (the retry-loop shape); one-shot sleeps (the ``[faults]``
+    stall/slow injections) stay legal.  The detector is self-tested on a
+    synthetic offender because the package rightly contains none."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+
+    def retry_sleep_lines(tree):
+        hits = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = list(ast.walk(node))
+            has_try = any(isinstance(n, ast.Try) for n in body)
+            for n in body:
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "sleep"
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "time"
+                        and has_try):
+                    hits.append(n.lineno)
+        return hits
+
+    synthetic = (
+        "import time\n"
+        "def naive(fn):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return fn()\n"
+        "        except OSError:\n"
+        "            time.sleep(1.0)\n")
+    assert retry_sleep_lines(ast.parse(synthetic)) == [7]
+
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        offenders += [f"{path}:{ln}" for ln in retry_sleep_lines(tree)]
+    assert not offenders, (
+        "hand-rolled time.sleep retry loop (use utils/retry.py retry_call: "
+        "bounded attempts, jittered backoff, JSONL records, fault hook): "
+        + ", ".join(offenders))

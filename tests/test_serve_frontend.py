@@ -265,3 +265,150 @@ def test_serving_programs_are_scatter_free(scorer8, mesh8):
         queries, corpus.vectors).as_text()
     s, ids = retrieve(queries)
     assert s.shape == (4, 10) and ids.shape == (4, 10)
+
+
+# ------------------------------------------- overload shedding + hot swap
+
+
+def test_shed_past_deadline_first(tmp_path):
+    """With max_queue set, an arriving request first evicts pending requests
+    already past the batch deadline (oldest first) — they would miss their
+    promised latency anyway — and only then displaces a survivor."""
+    logger = MetricLogger(tmp_path)
+    score, _ = _counting_score()
+    clk = FakeClock()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=5.0,
+                      max_queue=2, shed_policy="oldest", logger=logger,
+                      clock=clk)
+    mb.submit("a", {"x": np.arange(1)})
+    mb.submit("b", {"x": np.arange(1)})
+    clk.advance(0.006)  # both now past the 5 ms deadline
+    mb.submit("c", {"x": np.arange(1)})
+    # exactly enough stale evictions to admit c: a sheds, b survives (a
+    # stale-but-queued request still ships on the next poll — shedding it
+    # without need would discard accepted work)
+    assert mb.shed == [("a", "past_deadline")]
+    assert mb.results["a"] is None
+    mb.submit("d", {"x": np.arange(1)})  # full again; stale b evicted
+    assert mb.shed == [("a", "past_deadline"), ("b", "past_deadline")]
+    assert mb.results["b"] is None
+    mb.submit("e", {"x": np.arange(1)})  # nothing stale -> displace oldest
+    assert mb.shed[-1] == ("c", "displaced")
+    mb.drain()
+    logger.close()
+    assert mb.results["d"] is not None and mb.results["e"] is not None
+    records = [json.loads(l) for l in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    sheds = [r for r in records if r.get("event") == "serve_request"
+             and r["outcome"] == "shed"]
+    assert [(r["request"], r["shed_reason"]) for r in sheds] == [
+        ("a", "past_deadline"), ("b", "past_deadline"), ("c", "displaced")]
+    assert mb.stats()["shed"] == 3
+
+
+def test_shed_policy_reject_bounces_arrival():
+    """shed_policy='reject': when nothing pending is stale, the ARRIVING
+    request bounces instead of displacing an accepted one."""
+    score, _ = _counting_score()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=1e9,
+                      max_queue=1, shed_policy="reject", clock=FakeClock())
+    mb.submit("kept", {"x": np.arange(1)})
+    mb.submit("bounced", {"x": np.arange(1)})
+    assert mb.shed == [("bounced", "rejected")]
+    assert mb.results["bounced"] is None
+    mb.drain()
+    np.testing.assert_array_equal(mb.results["kept"], np.arange(1) * 2.0)
+
+
+def test_shed_knob_validation():
+    score, _ = _counting_score()
+    with pytest.raises(ValueError, match="max_queue"):
+        MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=1,
+                     max_queue=-1)
+    with pytest.raises(ValueError, match="shed_policy"):
+        MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=1,
+                     shed_policy="drop-newest")
+
+
+def test_swap_drains_on_old_scorer_and_drops_nothing(tmp_path):
+    """Hot swap under live traffic: accepted in-flight requests drain on the
+    OLD scorer (tagged under_swap), post-swap traffic scores on the new one,
+    and no accepted request is dropped."""
+    logger = MetricLogger(tmp_path)
+    old, _ = _counting_score()        # x * 2
+    new = lambda batch: np.asarray(batch["x"], np.float32) * 3.0  # noqa: E731
+    clk = FakeClock()
+    mb = MicroBatcher(old, buckets=(8,), max_batch=8, batch_deadline_ms=1e9,
+                      logger=logger, clock=clk)
+    mb.submit("inflight0", {"x": np.arange(2)})
+    mb.submit("inflight1", {"x": np.arange(2)})
+    swap_ms = mb.swap(new, version=7)
+    assert swap_ms >= 0.0
+    mb.submit("after", {"x": np.arange(2)})
+    mb.drain()
+    logger.close()
+    # zero dropped: every accepted request has a real result
+    np.testing.assert_array_equal(mb.results["inflight0"], np.arange(2) * 2.0)
+    np.testing.assert_array_equal(mb.results["inflight1"], np.arange(2) * 2.0)
+    np.testing.assert_array_equal(mb.results["after"], np.arange(2) * 3.0)
+    assert mb.swaps == [{"version": 7, "from_version": None,
+                         "drained_rows": 4, "swap_ms": swap_ms}]
+    records = [json.loads(l) for l in
+               (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    by_req = {r["request"]: r for r in records
+              if r.get("event") == "serve_request"}
+    assert by_req["inflight0"]["under_swap"] is True
+    assert by_req["after"]["under_swap"] is False
+    assert by_req["after"]["version"] == 7
+    swaps = [r for r in records if r.get("event") == "serve_swap"]
+    assert len(swaps) == 1 and swaps[0]["drained_rows"] == 4
+    stats = mb.stats()
+    assert stats["swaps"] == 1
+    # drain happened under a fake clock: the p99-under-swap bound is exact
+    assert stats["p99_under_swap_ms"] == 0.0
+
+
+def test_swap_resets_program_cache_probe():
+    """The old scorer's program-cache probe is stale after a flip; keeping it
+    would fail the bounded-jit-cache assertion against the WRONG scorer."""
+    score, _ = _counting_score()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=0.0,
+                      clock=FakeClock(), program_cache_size=lambda: 99)
+    mb.swap(score, version=1)  # no probe passed -> probe cleared
+    mb.run([("r", {"x": np.arange(3)})])  # would raise with the stale probe
+    np.testing.assert_array_equal(mb.results["r"], np.arange(3) * 2.0)
+    leaky = MicroBatcher(score, buckets=(8,), max_batch=8,
+                         batch_deadline_ms=0.0, clock=FakeClock())
+    leaky.swap(score, version=1, program_cache_size=lambda: 2)
+    with pytest.raises(RuntimeError, match="bounded-jit-cache"):
+        leaky.submit("r", {"x": np.arange(8)})
+
+
+def test_slow_score_fault_and_serve_heartbeat(tmp_path):
+    """[faults] slow_score_ms wedges the scorer deterministically; the
+    frontend beats the serving watchdog per shipped batch, so a wedged
+    scorer trips the SAME stall machinery as a wedged train step."""
+    import time as _time
+
+    from tdfo_tpu.obs.watchdog import StallWatchdog
+    from tdfo_tpu.utils import faults
+    from tdfo_tpu.utils.faults import FaultSpec
+
+    wd = StallWatchdog(tmp_path / "hb.jsonl", 60.0, label="serve",
+                       clock=lambda: 0.0)
+    score, _ = _counting_score()
+    mb = MicroBatcher(score, buckets=(8,), max_batch=8, batch_deadline_ms=0.0,
+                      clock=FakeClock(), watchdog=wd)
+    try:
+        faults.configure(FaultSpec(slow_score_ms=30.0))
+        t0 = _time.perf_counter()
+        mb.run([("r", {"x": np.arange(2)})])
+        elapsed_ms = (_time.perf_counter() - t0) * 1000.0
+    finally:
+        faults.configure(None)
+    assert elapsed_ms >= 30.0  # the injected stall really happened
+    np.testing.assert_array_equal(mb.results["r"], np.arange(2) * 2.0)
+    wd.check()
+    hb = [json.loads(l) for l in
+          (tmp_path / "hb.jsonl").read_text().splitlines()]
+    assert hb[-1]["label"] == "serve" and hb[-1]["last_step"] == 1
