@@ -34,6 +34,7 @@ __all__ = [
     "MeshSpec",
     "FaultSpec",
     "EmbeddingsSpec",
+    "OnlineSpec",
     "ServingSpec",
     "TelemetrySpec",
     "TrainSpec",
@@ -195,12 +196,24 @@ class ServingSpec:
     # nearest to broken anyway), "reject" bounces the new arrival
     shed_policy: str = "oldest"
     # how often the serving loop checks the export chain for the successor
-    # delta bundle (serve/swap.py DeltaPoller cadence)
+    # delta bundle (serve/swap.py DeltaPoller cadence).  0 polls every tick;
+    # a backwards host-clock jump re-arms rather than stalling (the poller
+    # runs on an injectable monotonic-ish clock — see tests).
     swap_poll_s: float = 1.0
     # consecutive quarantined (digest-corrupt) deltas before the frontend
     # flips the degraded flag into its heartbeat — still serving the last
     # good version, but loudly
     max_bad_deltas: int = 3
+    # log full feature payloads (+ labels when present) into the request
+    # JSONL so served traffic can replay as an incremental training stream
+    # (data/replay.py; Monolith §3.3 online-training joiner analogue).
+    # Default-off: feature payloads multiply the log's byte rate.
+    log_features: bool = False
+    # rotate the request log into a sealed, digest-stamped segment once the
+    # active file reaches this many bytes (0 = one unbounded segment).
+    # Replay tails sealed segments with end-to-end verification; rotation
+    # is atomic (seal lands before the successor opens).
+    log_segment_bytes: int = 0
 
 
 @dataclass(frozen=True)
@@ -250,6 +263,51 @@ class TelemetrySpec:
     # seconds (the "tunnel hung >180 s" failure mode, made diagnosable).
     # 0 disables the watchdog thread (heartbeat.jsonl is not written).
     stall_timeout_s: float = 0.0
+    # size-based rotation for the run's append-only JSONL sinks
+    # (metrics.jsonl via MetricLogger, retries.jsonl via utils/retry): when
+    # a sink crosses this many bytes it is atomically renamed to `<name>.1`
+    # (replacing any previous overflow) and a fresh file continues — a
+    # long-running online loop must not fill the disk.  0 = unbounded.
+    log_rotate_bytes: int = 0
+
+
+@dataclass(frozen=True)
+class OnlineSpec:
+    """``[online]`` config table: the serve -> retrain -> delta-export ->
+    swap supervisor (``tdfo_tpu/train/online.py``; Monolith §3.3 online
+    training / torchrec streaming-retrain analogue).
+
+    The supervisor tails the frontend's request log through the crash-safe
+    replay consumer (``data/replay.py``), trains ``steps_per_cycle``
+    incremental steps, checkpoints state + replay cursor atomically, then
+    ``export_delta`` -> ``BundleStore`` publish -> ``MicroBatcher.swap`` —
+    forever (or ``max_cycles``).  Every knob below is observable
+    (``tests/test_online.py`` / ``tests/test_replay.py``).
+    """
+
+    # directory of request-log segments to tail ("" disables the online
+    # loop; `launch online` requires it).  The frontend writes it when
+    # [serving] log_features is on.
+    request_log: str = ""
+    # incremental train steps (= replay batches) per cycle before the
+    # delta-export/publish/swap stages run.  Each step consumes one
+    # per_device_train_batch_size * data-axis batch from the log.
+    steps_per_cycle: int = 8
+    # stop after this many full cycles (0 = run until the log is exhausted
+    # — the test/drain mode; production tails forever).
+    max_cycles: int = 0
+    # complete-but-garbage log records tolerated (quarantined with a
+    # counter) before replay fails the run — mirrors max_bad_shards.
+    # 0 = any bad record is fatal.
+    max_bad_records: int = 0
+    # bounded-lag backpressure: when replay falls more than this many
+    # records behind the durable log head, lag_policy decides (0 = lag is
+    # unbounded, the metric still reports).
+    max_lag_records: int = 0
+    # "fail" refuses to train on stale data (raises once max_lag_records is
+    # exceeded); "skip" drops oldest records down to the bound — counted in
+    # replay/skipped — and keeps training on fresh traffic.
+    lag_policy: str = "fail"
 
 
 @dataclass(frozen=True)
@@ -405,6 +463,8 @@ class Config:
     serving: ServingSpec = field(default_factory=ServingSpec)
     # [telemetry] table: flight-recorder knobs (tdfo_tpu/obs)
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
+    # [online] table: serve -> retrain -> swap supervisor knobs
+    online: OnlineSpec = field(default_factory=OnlineSpec)
     planner: PlannerSpec = field(default_factory=PlannerSpec)
     mesh: MeshSpec = field(default_factory=MeshSpec)
 
@@ -649,9 +709,41 @@ class Config:
                 "serving max_batch must fit the largest bucket: a full batch "
                 f"of {self.serving.max_batch} rows cannot pad into "
                 f"buckets[-1] = {self.serving.buckets[-1]}")
+        if self.serving.log_segment_bytes < 0:
+            raise ValueError(
+                "serving log_segment_bytes must be >= 0 (0 = one unbounded "
+                "request-log segment)")
+        if self.serving.log_segment_bytes and not self.serving.log_features:
+            raise ValueError(
+                "serving log_segment_bytes rotates the replayable request "
+                "log, which only exists with log_features = true")
         if self.telemetry.stall_timeout_s < 0:
             raise ValueError(
                 "telemetry stall_timeout_s must be >= 0 (0 = watchdog off)")
+        if self.telemetry.log_rotate_bytes < 0:
+            raise ValueError(
+                "telemetry log_rotate_bytes must be >= 0 (0 = unbounded "
+                "metrics/retries JSONL)")
+        if self.online.steps_per_cycle < 1:
+            raise ValueError("online steps_per_cycle must be >= 1")
+        if self.online.max_cycles < 0:
+            raise ValueError(
+                "online max_cycles must be >= 0 (0 = drain the log)")
+        if self.online.max_bad_records < 0:
+            raise ValueError(
+                "online max_bad_records must be >= 0 (0 = fail on any)")
+        if self.online.max_lag_records < 0:
+            raise ValueError(
+                "online max_lag_records must be >= 0 (0 = unbounded lag)")
+        if self.online.lag_policy not in ("fail", "skip"):
+            raise ValueError(
+                "online lag_policy must be 'fail' or 'skip', got "
+                f"{self.online.lag_policy!r}")
+        if self.online.request_log and not self.checkpoint_dir:
+            raise ValueError(
+                "online.request_log requires checkpoint_dir: the replay "
+                "cursor persists as a checkpoint sidecar — without it the "
+                "loop cannot be crash-safe")
         if self.planner.hbm_gb < 0:
             raise ValueError(
                 "planner hbm_gb must be >= 0 (0 = unlimited device memory)")
@@ -736,6 +828,7 @@ _EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
 _TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainSpec)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingSpec)}
 _TELEMETRY_FIELDS = {f.name for f in dataclasses.fields(TelemetrySpec)}
+_ONLINE_FIELDS = {f.name for f in dataclasses.fields(OnlineSpec)}
 _PLANNER_FIELDS = {f.name for f in dataclasses.fields(PlannerSpec)}
 
 
@@ -816,6 +909,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                 f"unknown telemetry config keys: {sorted(unknown_telemetry)}")
         telemetry = TelemetrySpec(**telemetry_raw)
 
+    online_raw = raw.pop("online", {})
+    if isinstance(online_raw, OnlineSpec):
+        online = online_raw
+    else:
+        unknown_online = set(online_raw) - _ONLINE_FIELDS
+        if unknown_online:
+            raise ValueError(
+                f"unknown online config keys: {sorted(unknown_online)}")
+        online = OnlineSpec(**online_raw)
+
     planner_raw = raw.pop("planner", {})
     if isinstance(planner_raw, PlannerSpec):
         planner = planner_raw
@@ -837,7 +940,8 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
     cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, train=train,
-                 serving=serving, telemetry=telemetry, planner=planner, **raw)
+                 serving=serving, telemetry=telemetry, online=online,
+                 planner=planner, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

@@ -1,0 +1,530 @@
+"""Crash-safe request-log replay: the serve -> retrain stream adapter.
+
+The serving frontend records every scored request as JSONL (torchrec's
+streaming-retrain input and Monolith §3.3's online training joiner keep the
+same artifact: a log of served traffic that doubles as the incremental
+training stream).  This module owns BOTH ends of that file:
+
+  * ``RequestLog`` — the writer.  Appends are segment-rotated at a byte
+    threshold; a finished segment is sealed by an atomically-published
+    sidecar carrying its byte count and sha256, so a reader can verify a
+    sealed segment end-to-end before trusting a single record.  Reopening
+    after a crash truncates a torn tail line and resumes the ``seq``
+    numbering from the last durable record — the writer never emits two
+    records with the same seq and never leaves a half-record in front of a
+    new append.
+
+  * ``ReplayConsumer`` — the reader.  Tails the segment chain from a
+    byte-offset cursor (persisted as a checkpoint sidecar by the online
+    supervisor, the same idiom as PR 1's stream cursors) and forms
+    deterministic fixed-size training batches.  Exactly-once delivery is the
+    contract: the cursor only commits when a FULL batch assembles
+    (all-or-nothing, so a kill mid-assembly re-reads the same rows), ``seq``
+    dedup drops writer-retry duplicates, sealed segments are digest-verified
+    once, a torn tail in the active segment stops the tail (more data may
+    yet arrive) instead of erroring, and complete-but-garbage lines are
+    quarantined up to ``max_bad_records`` then fatal — mirroring the shard
+    loader's ``max_bad_shards``.
+
+Counter / cursor bookkeeping lives INSIDE the cursor dict so a resumed
+process recounts nothing: ``records`` (trained), ``bad`` (quarantined),
+``dup`` (deduped), ``skipped`` (non-training or backpressure-dropped) all
+travel with the byte position.  ``counters()`` surfaces them — plus the
+measured records-behind ``replay/lag`` — through the PR-7 telemetry path.
+
+Reading JSONL line-by-line outside this module is rejected by
+``tests/test_quality.py``: ad-hoc tailers would bypass the truncation and
+digest checks that make replay exactly-once.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Any, Iterator
+
+import numpy as np
+
+from tdfo_tpu.utils import faults as _faults
+
+__all__ = [
+    "REPLAY_SCHEMA_VERSION",
+    "ReplayError",
+    "ReplayLagError",
+    "RequestLog",
+    "ReplayConsumer",
+]
+
+REPLAY_SCHEMA_VERSION = 1
+
+
+class ReplayError(RuntimeError):
+    """Unrecoverable log damage: digest mismatch, unsealed non-final
+    segment, or the bad-record quarantine budget exhausted."""
+
+
+class ReplayLagError(ReplayError):
+    """The consumer fell further behind than ``max_lag_records`` under the
+    fail-hard backpressure policy."""
+
+
+def _seg_name(i: int) -> str:
+    return f"requests-{i:06d}.jsonl"
+
+
+def _seal_name(i: int) -> str:
+    return f"requests-{i:06d}.seal.json"
+
+
+def _list_segments(root: Path) -> list[int]:
+    out = []
+    for p in root.glob("requests-*.jsonl"):
+        stem = p.name[len("requests-"):-len(".jsonl")]
+        if stem.isdigit():
+            out.append(int(stem))
+    return sorted(out)
+
+
+# --------------------------------------------------------------------- writer
+
+
+class RequestLog:
+    """Append-only, segment-rotated JSONL writer with sealed digests.
+
+    ``segment_bytes = 0`` disables rotation (single growing segment —
+    fine for tests, wrong for a long-running frontend).  Rotation order is
+    the crash-safety invariant: the seal sidecar is atomically published
+    (temp + fsync + rename, via the swap store's sanctioned helper) BEFORE
+    the next segment is created, so a reader that finds an unsealed segment
+    with a successor knows the chain is damaged rather than racing.
+    """
+
+    def __init__(self, root: str | Path, *, segment_bytes: int = 0):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.segment_bytes = int(segment_bytes)
+        segs = _list_segments(self.root)
+        self._seg = segs[-1] if segs else 0
+        self._seq = 0
+        if segs and (self.root / _seal_name(self._seg)).exists():
+            # crashed between sealing and opening the successor: resume seq
+            # from the seal and start the next segment fresh
+            seal = json.loads((self.root / _seal_name(self._seg)).read_text())
+            self._seq = int(seal.get("last_seq") or 0)
+            self._seg += 1
+        elif segs:
+            self._seq = self._recover_active(self.root / _seg_name(self._seg))
+            if self._seg:
+                # a fresh (or torn-empty) active segment carries no seqs —
+                # continuity lives in the predecessor's seal
+                prev = self.root / _seal_name(self._seg - 1)
+                if prev.exists():
+                    seal = json.loads(prev.read_text())
+                    self._seq = max(self._seq,
+                                    int(seal.get("last_seq") or 0))
+        self._path = self.root / _seg_name(self._seg)
+        self._first_seq = None  # first seq in the ACTIVE segment
+        self._records = 0  # lines in the active segment
+        self._f = open(self._path, "ab")
+        if self._path.stat().st_size:
+            first, n = self._scan_segment(self._path)[:2]
+            self._first_seq, self._records = first, n
+
+    def _recover_active(self, path: Path) -> int:
+        """Truncate a torn tail line (no trailing newline) and return the
+        highest seq among the surviving complete records."""
+        data = path.read_bytes()
+        cut = data.rfind(b"\n") + 1  # 0 when no complete line survives
+        if cut != len(data):
+            with open(path, "r+b") as f:
+                f.truncate(cut)
+        last = 0
+        for line in data[:cut].split(b"\n"):
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                last = max(last, int(rec.get("seq") or 0))
+            except (ValueError, TypeError):
+                continue  # corrupt line: reader quarantines it; seq unknown
+        return last
+
+    def _scan_segment(self, path: Path) -> tuple[int | None, int, int]:
+        """(first_seq, line_count, last_seq) of a segment's complete lines."""
+        first, last, n = None, 0, 0
+        for line in path.read_bytes().split(b"\n"):
+            if not line:
+                continue
+            n += 1
+            try:
+                seq = int(json.loads(line).get("seq") or 0)
+            except (ValueError, TypeError):
+                continue
+            first = seq if first is None else first
+            last = max(last, seq)
+        return first, n, last
+
+    # ------------------------------------------------------------------ api
+
+    def append(self, record: dict[str, Any]) -> int:
+        """Append one record (stamped with ``seq`` + ``schema_version``),
+        flush it to the OS, and rotate if the segment crossed the byte
+        threshold.  Returns the assigned seq."""
+        self._seq += 1
+        seq = self._seq
+        rec = dict(record)
+        rec["seq"] = seq
+        rec["schema_version"] = REPLAY_SCHEMA_VERSION
+        line = (json.dumps(rec) + "\n").encode()
+        inj = _faults.active()
+        if inj is not None and inj.corrupt_record_due():
+            # complete-but-garbage line: '{' -> '#' can never parse as JSON,
+            # driving the reader's quarantine on a REAL sealed bad line
+            line = b"#" + line[1:]
+        self._f.write(line)
+        if inj is not None and inj.dup_record_due():
+            self._f.write(line)  # same seq twice: the at-least-once artifact
+            self._records += 1
+        self._f.flush()
+        if self._first_seq is None:
+            self._first_seq = seq
+        self._records += 1
+        if inj is not None:
+            size = self._f.tell()
+            if inj.truncate_log_due(size):
+                # torn tail mid-record, as a crashed writer leaves it; a
+                # reopened RequestLog truncates it, the reader stops before it
+                self._f.truncate(inj.spec.truncate_log_at_byte)
+                self._f.seek(inj.spec.truncate_log_at_byte)
+        if self.segment_bytes and self._f.tell() >= self.segment_bytes:
+            self._rotate()
+        return seq
+
+    def _rotate(self) -> None:
+        """Seal the active segment (fsync data, publish the digest sidecar)
+        THEN open the successor — a reader can always tell 'rotation in
+        flight' from 'chain damaged'."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        self.seal_segment(self._seg)
+        self._seg += 1
+        self._path = self.root / _seg_name(self._seg)
+        self._f = open(self._path, "ab")
+        self._first_seq = None
+        self._records = 0
+
+    def seal_segment(self, seg: int) -> None:
+        """Publish the digest sidecar for a finished segment."""
+        from tdfo_tpu.serve.swap import atomic_write_json
+
+        path = self.root / _seg_name(seg)
+        data = path.read_bytes()
+        first, n, last = self._scan_segment(path)
+        atomic_write_json(self.root / _seal_name(seg), {
+            "segment": seg,
+            "schema_version": REPLAY_SCHEMA_VERSION,
+            "bytes": len(data),
+            "records": n,
+            "first_seq": first,
+            "last_seq": last,
+            "sha256": hashlib.sha256(data).hexdigest(),
+        })
+
+    def seal_active(self) -> None:
+        """Force-seal the active segment (end-of-stream marker for tests and
+        drained frontends) and open a fresh successor on the next append."""
+        if self._f.closed:
+            return
+        if self._path.stat().st_size == 0:
+            return  # nothing to seal; an empty sealed segment is noise
+        self._rotate()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    @property
+    def active_segment(self) -> int:
+        return self._seg
+
+    @property
+    def last_seq(self) -> int:
+        return self._seq
+
+
+# --------------------------------------------------------------------- reader
+
+
+def _fresh_cursor() -> dict[str, int]:
+    return {"segment": 0, "offset": 0, "row": 0,
+            "records": 0, "bad": 0, "dup": 0, "skipped": 0, "last_seq": 0}
+
+_CURSOR_KEYS = frozenset(_fresh_cursor())
+
+
+class ReplayConsumer:
+    """Exactly-once batch former over a ``RequestLog`` directory.
+
+    ``schema`` is the trainer's ``_eval_schema`` dict (``{column: (dtype,
+    shape)}``); only records whose feature payload validates against it
+    train.  ``cursor`` resumes from a previously committed position (the
+    checkpoint sidecar); omit it to start at segment 0, byte 0.
+    """
+
+    def __init__(self, root: str | Path, *, schema: dict[str, tuple],
+                 batch_size: int, max_bad_records: int = 0,
+                 max_lag_records: int = 0, lag_policy: str = "fail",
+                 cursor: dict[str, int] | None = None):
+        if lag_policy not in ("fail", "skip"):
+            raise ValueError(f"lag_policy must be 'fail' or 'skip', "
+                             f"got {lag_policy!r}")
+        for col, (_, shape) in schema.items():
+            if tuple(shape) != ():
+                raise ValueError(
+                    f"replay schema column {col!r} must be scalar-per-row, "
+                    f"got shape {tuple(shape)} — replay feeds the CTR regime")
+        self.root = Path(root)
+        self.schema = dict(schema)
+        self.batch_size = int(batch_size)
+        self.max_bad_records = int(max_bad_records)
+        self.max_lag_records = int(max_lag_records)
+        self.lag_policy = lag_policy
+        cur = _fresh_cursor()
+        if cursor is not None:
+            unknown = set(cursor) - _CURSOR_KEYS
+            if unknown:
+                raise ValueError(f"unknown replay cursor keys: {sorted(unknown)}")
+            cur.update({k: int(v) for k, v in cursor.items()})
+        self._cursor = cur
+        self._verified: set[int] = set()
+
+    # ------------------------------------------------------------- segments
+
+    def _seal(self, seg: int) -> dict | None:
+        p = self.root / _seal_name(seg)
+        if not p.exists():
+            return None
+        return json.loads(p.read_text())
+
+    def _verify(self, seg: int, seal: dict) -> None:
+        if seg in self._verified:
+            return
+        data = (self.root / _seg_name(seg)).read_bytes()
+        if len(data) != seal["bytes"]:
+            raise ReplayError(
+                f"sealed segment {_seg_name(seg)} is {len(data)} bytes, seal "
+                f"says {seal['bytes']} — truncated after sealing")
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != seal["sha256"]:
+            raise ReplayError(
+                f"sealed segment {_seg_name(seg)} digest mismatch "
+                f"({digest[:12]} != {seal['sha256'][:12]}) — refusing to "
+                f"replay silently corrupted traffic")
+        self._verified.add(seg)
+
+    def _segment_bytes(self, seg: int) -> bytes | None:
+        """Readable bytes of a segment: the verified whole file when sealed,
+        everything up to the last complete line when active, ``None`` when
+        the segment does not exist yet."""
+        path = self.root / _seg_name(seg)
+        if not path.exists():
+            return None
+        seal = self._seal(seg)
+        if seal is not None:
+            self._verify(seg, seal)
+            data = path.read_bytes()
+            if data and not data.endswith(b"\n"):
+                raise ReplayError(
+                    f"sealed segment {_seg_name(seg)} ends mid-record — the "
+                    f"writer seals only complete lines; refusing torn data")
+            return data
+        if (self.root / _seg_name(seg + 1)).exists():
+            raise ReplayError(
+                f"segment {_seg_name(seg)} has a successor but no seal — "
+                f"the rotation order guarantees seals land first; this "
+                f"chain is damaged")
+        data = path.read_bytes()
+        cut = data.rfind(b"\n") + 1  # torn tail: wait, don't error
+        return data[:cut]
+
+    def _lines(self, cur: dict[str, int]) -> Iterator[tuple[bytes, int, int]]:
+        """Yield ``(line, segment, next_offset)`` for every complete line at
+        or after the cursor, crossing sealed segment boundaries."""
+        seg, offset = cur["segment"], cur["offset"]
+        while True:
+            data = self._segment_bytes(seg)
+            if data is None:
+                return
+            while offset < len(data):
+                end = data.index(b"\n", offset) + 1
+                yield data[offset:end - 1], seg, end
+                offset = end
+            if self._seal(seg) is None:
+                return  # active segment exhausted: no more durable data yet
+            seg, offset = seg + 1, 0
+
+    # -------------------------------------------------------------- records
+
+    def _classify(self, line: bytes, cur: dict[str, int]):
+        """Parse + validate one complete line against the cursor's dedup
+        state.  Returns ``("train", record, columns)`` /
+        ``("skip"|"dup"|"bad", reason, None)`` and updates the dedup seq."""
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("record is not an object")
+        except (ValueError, TypeError) as e:
+            return "bad", f"unparseable line: {e}", None
+        seq = rec.get("seq")
+        if not isinstance(seq, int) or seq <= 0:
+            return "bad", "missing/invalid seq", None
+        if seq <= cur["last_seq"]:
+            return "dup", f"seq {seq} already consumed", None
+        cur["last_seq"] = seq
+        if rec.get("schema_version") != REPLAY_SCHEMA_VERSION:
+            return "bad", f"schema_version {rec.get('schema_version')!r}", None
+        if (rec.get("event") != "serve_request" or rec.get("outcome") != "ok"
+                or "features" not in rec):
+            return "skip", "not a trainable serve_request", None
+        feats = rec["features"]
+        if not isinstance(feats, dict):
+            return "bad", "features is not an object", None
+        rows = rec.get("rows")
+        if not isinstance(rows, int) or rows <= 0:
+            return "bad", "missing/invalid rows", None
+        cols = {}
+        for col, (dtype, _) in self.schema.items():
+            vals = feats.get(col)
+            if not isinstance(vals, list) or len(vals) != rows:
+                return "bad", f"feature {col!r} missing or wrong length", None
+            try:
+                arr = np.asarray(vals, dtype=dtype)
+            except (ValueError, TypeError, OverflowError):
+                return "bad", f"feature {col!r} not castable to {dtype}", None
+            cols[col] = arr
+        return "train", rec, cols
+
+    # ------------------------------------------------------------------ api
+
+    def cursor(self) -> dict[str, int]:
+        """The committed cursor (a copy — safe to persist as-is)."""
+        return dict(self._cursor)
+
+    def lag(self) -> int:
+        """Complete records durable in the log but not yet consumed — the
+        records-behind backpressure metric (``replay/lag``)."""
+        n = 0
+        seg, offset = self._cursor["segment"], self._cursor["offset"]
+        while True:
+            data = self._segment_bytes(seg)
+            if data is None:
+                return n
+            n += data.count(b"\n", offset)
+            if self._seal(seg) is None:
+                return n
+            seg, offset = seg + 1, 0
+
+    def counters(self) -> dict[str, float]:
+        """Replay counters for the telemetry JSONL (PR-7 naming)."""
+        c = self._cursor
+        return {
+            "replay/records": float(c["records"]),
+            "replay/bad": float(c["bad"]),
+            "replay/dup": float(c["dup"]),
+            "replay/skipped": float(c["skipped"]),
+            "replay/lag": float(self.lag()),
+        }
+
+    def check_backpressure(self) -> int:
+        """Enforce the bounded-lag policy.  Returns the measured lag.
+        ``fail``: raise ``ReplayLagError`` beyond ``max_lag_records``.
+        ``skip``: drop whole records (counted, dedup-consistent) until at
+        most ``max_lag_records`` remain — skip-to-fresh for a consumer that
+        prefers recency over completeness."""
+        lag = self.lag()
+        if not self.max_lag_records or lag <= self.max_lag_records:
+            return lag
+        if self.lag_policy == "fail":
+            raise ReplayLagError(
+                f"replay is {lag} records behind (max_lag_records="
+                f"{self.max_lag_records}); the frontend outpaces training — "
+                f"fail-hard policy refuses to silently train on stale data")
+        cur = dict(self._cursor)
+        to_drop = lag - self.max_lag_records
+        for line, seg, next_offset in self._lines(cur):
+            if to_drop <= 0:
+                break
+            try:
+                rec = json.loads(line)
+                seq = rec.get("seq")
+                if isinstance(seq, int) and seq > cur["last_seq"]:
+                    cur["last_seq"] = seq
+            except (ValueError, TypeError):
+                pass  # unparseable skipped line: nothing to dedup against
+            cur["segment"], cur["offset"], cur["row"] = seg, next_offset, 0
+            cur["skipped"] += 1
+            to_drop -= 1
+        self._cursor = cur
+        return self.lag()
+
+    def next_batch(self):
+        """Assemble one deterministic batch of exactly ``batch_size`` rows.
+
+        Returns ``(batch, consumed)`` — ``batch`` maps schema columns to
+        ``[batch_size]`` arrays; ``consumed`` lists ``(seq, row_start,
+        row_end)`` spans for record-id accounting — or ``None`` when fewer
+        than ``batch_size`` rows are durably available (partial progress is
+        discarded; the cursor only ever commits whole batches)."""
+        cur = dict(self._cursor)
+        taken: dict[str, list] = {col: [] for col in self.schema}
+        consumed: list[tuple[int, int, int]] = []
+        need = self.batch_size
+        for line, seg, next_offset in self._lines(cur):
+            prev_seq = cur["last_seq"]  # restored on a mid-record boundary
+            kind, info, cols = self._classify(line, cur)
+            if kind == "bad":
+                cur["bad"] += 1
+                if cur["bad"] > self.max_bad_records:
+                    raise ReplayError(
+                        f"bad request-log record #{cur['bad']} exceeds "
+                        f"max_bad_records={self.max_bad_records} "
+                        f"(segment {seg}): {info}")
+                cur["segment"], cur["offset"], cur["row"] = seg, next_offset, 0
+                continue
+            if kind in ("dup", "skip"):
+                cur["dup" if kind == "dup" else "skipped"] += 1
+                cur["segment"], cur["offset"], cur["row"] = seg, next_offset, 0
+                continue
+            rec, start = info, cur["row"]
+            rows = rec["rows"]
+            if start >= rows:  # cursor damage: row offset beyond the record
+                raise ReplayError(
+                    f"cursor row {start} >= record rows {rows} at seq "
+                    f"{rec['seq']} — cursor does not match this log")
+            stop = min(rows, start + need)
+            for col, arr in cols.items():
+                taken[col].append(arr[start:stop])
+            consumed.append((rec["seq"], start, stop))
+            need -= stop - start
+            if stop == rows:
+                cur["records"] += 1
+                cur["segment"], cur["offset"], cur["row"] = seg, next_offset, 0
+            else:
+                # mid-record batch boundary: stay ON this line, resume at row
+                # `stop`; un-bump the dedup seq so the re-read is not a dup
+                cur["row"] = stop
+                cur["last_seq"] = prev_seq
+            if need == 0:
+                break
+        if need > 0:
+            return None  # not enough durable rows: all-or-nothing, no commit
+        batch = {col: np.concatenate(parts) for col, parts in taken.items()}
+        self._cursor = cur
+        inj = _faults.active()
+        if inj is not None:
+            inj.maybe_kill_replay(cur["records"])
+        return batch, consumed

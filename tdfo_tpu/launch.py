@@ -11,6 +11,10 @@ Subcommands:
   * ``serve``                — export the newest checkpoint to a serving
     bundle and run the micro-batching scoring frontend (+ a retrieval round
     for TwoTower); knobs live in the ``[serving]`` config table.
+  * ``online``               — close the loop: replay the frontend's request
+    log (``[serving] log_features``) into incremental training cycles, each
+    ending in a delta export + hot swap (``tdfo_tpu/train/online.py``);
+    knobs live in the ``[online]`` config table.
   * ``plan``                 — price every per-table embedding placement
     against the measured cost model (``tdfo_tpu/plan``) using the
     preprocessing ``table_stats.json`` and write ``sharding_plan.json``;
@@ -44,9 +48,9 @@ def _init_distributed(flag: str) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
     p.add_argument("command", nargs="?", default="train",
-                   choices=["train", "serve", "plan", "preprocess-ctr",
-                            "preprocess-seq", "preprocess-criteo", "synth",
-                            "synth-criteo"])
+                   choices=["train", "serve", "online", "plan",
+                            "preprocess-ctr", "preprocess-seq",
+                            "preprocess-criteo", "synth", "synth-criteo"])
     p.add_argument("--config", default="config.toml", help="path to config.toml")
     p.add_argument("--data-dir", default=None, help="override config data_dir")
     p.add_argument("--distributed", default="auto", choices=["auto", "always", "never"],
@@ -161,6 +165,13 @@ def main(argv: list[str] | None = None) -> int:
         from tdfo_tpu.serve.frontend import serve_from_config
 
         stats = serve_from_config(cfg, log_dir=args.log_dir)
+        print({k: round(v, 5) if isinstance(v, float) else v
+               for k, v in stats.items()})
+        return 0
+    if args.command == "online":
+        from tdfo_tpu.train.online import online_from_config
+
+        stats = online_from_config(cfg, log_dir=args.log_dir)
         print({k: round(v, 5) if isinstance(v, float) else v
                for k, v in stats.items()})
         return 0

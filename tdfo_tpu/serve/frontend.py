@@ -65,6 +65,7 @@ class MicroBatcher:
         max_queue: int = 0,
         shed_policy: str = "oldest",
         watchdog=None,
+        request_log=None,
     ):
         buckets = tuple(buckets)
         if not buckets or list(buckets) != sorted(set(buckets)):
@@ -93,6 +94,14 @@ class MicroBatcher:
         # serving heartbeat: beat per shipped batch so a wedged scorer trips
         # the same stack-dump path as a wedged train step (obs/watchdog.py)
         self._watchdog = watchdog
+        # replayable traffic record ([serving] log_features): a
+        # data/replay.RequestLog that every served request's feature payload
+        # (+ label when the caller attached one) is appended to, so the
+        # online loop can replay traffic as a training stream.  Labels ride
+        # in as a reserved "label" column and are STRIPPED before scoring —
+        # the scorer's jit cache never sees them.
+        self._request_log = request_log
+        self._labels: dict[Any, np.ndarray] = {}
         self._ships = 0
         self._pending: list[tuple[Any, dict[str, np.ndarray], int, float]] = []
         self._pending_rows = 0
@@ -117,6 +126,11 @@ class MicroBatcher:
         n = len(next(iter(cols.values())))
         if any(len(v) != n for v in cols.values()):
             raise ValueError(f"request {request_id!r}: ragged columns")
+        if self._request_log is not None:
+            # feedback column: logged for replay, stripped before scoring
+            label = cols.pop("label", None)
+            if label is not None:
+                self._labels[request_id] = label
         if n > self._max_batch:
             raise ValueError(
                 f"request {request_id!r} has {n} rows > max_batch "
@@ -146,6 +160,14 @@ class MicroBatcher:
     def _record_shed(self, rid: Any, n: int, t0: float, reason: str) -> None:
         self.results[rid] = None  # the caller sees the outcome, not a KeyError
         self.shed.append((rid, reason))
+        if self._request_log is not None:
+            self._labels.pop(rid, None)
+            # shed requests were never scored: replay must see (and skip)
+            # them, so the record carries no feature payload
+            self._request_log.append({
+                "event": "serve_request", "request": str(rid), "rows": n,
+                "outcome": "shed", "shed_reason": reason,
+                "version": self._version})
         if self._logger is not None:
             self._logger.log(event="serve_request", request=str(rid), rows=n,
                              batch_rows=0, padded=0, queue_depth=len(self._pending),
@@ -216,13 +238,23 @@ class MicroBatcher:
         depth = len(self._pending)
         fill = rows / padded
         off = 0
-        for rid, _, n, t0 in take:
+        for rid, cols, n, t0 in take:
             self.results[rid] = scores[off:off + n]
             off += n
             latency_ms = (done - t0) * 1000.0
             self.latencies_ms.append(latency_ms)
             if self._swapping:
                 self._under_swap_ms.append(latency_ms)
+            if self._request_log is not None:
+                feats = {k: v.tolist() for k, v in cols.items()}
+                label = self._labels.pop(rid, None)
+                if label is not None:
+                    feats["label"] = label.tolist()
+                self._request_log.append({
+                    "event": "serve_request", "request": str(rid),
+                    "rows": n, "outcome": "ok", "features": feats,
+                    "under_swap": self._swapping, "version": self._version,
+                    "latency_ms": latency_ms})
             if self._logger is not None:
                 self._logger.log(event="serve_request", request=str(rid),
                                  rows=n, batch_rows=rows, padded=padded,
@@ -260,6 +292,12 @@ class MicroBatcher:
         swap_ms = (self._clock() - t0) * 1000.0
         self.swaps.append({"version": version, "from_version": old_version,
                            "drained_rows": drained, "swap_ms": swap_ms})
+        if self._request_log is not None:
+            # replay SKIPS non-request events; recording the swap in-stream
+            # timestamps which traffic each served version covers
+            self._request_log.append({
+                "event": "serve_swap", "version": version,
+                "from_version": old_version})
         if self._logger is not None:
             self._logger.log(event="serve_swap", version=version,
                              from_version=old_version, drained_rows=drained,
@@ -339,6 +377,16 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
     vocab = _column_vocab(config, cat_cols)
     rng = np.random.default_rng(config.seed)
     spec = config.serving
+    request_log = None
+    if spec.log_features:
+        from tdfo_tpu.data.replay import RequestLog
+
+        request_log = RequestLog(
+            Path(log_dir or config.checkpoint_dir or ".") / "request_log",
+            segment_bytes=spec.log_segment_bytes)
+    # labels come from a SEPARATE rng so turning log_features on never
+    # perturbs the request trace itself (the feedback join is out-of-band)
+    label_rng = np.random.default_rng(config.seed + 1)
     hi = min(spec.max_batch, spec.buckets[0])
     requests = []
     for i in range(n_requests):
@@ -349,6 +397,8 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
         }
         for c in cont_cols:
             batch[c] = rng.random(n, dtype=np.float32)
+        if request_log is not None:
+            batch["label"] = label_rng.integers(0, 2, size=n, dtype=np.int8)
         requests.append((f"req{i}", batch))
 
     watchdog = None
@@ -366,12 +416,15 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
         batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
         program_cache_size=scorer.score_cache_size,
         max_queue=spec.max_queue, shed_policy=spec.shed_policy,
-        watchdog=watchdog)
+        watchdog=watchdog, request_log=request_log)
     mb.run(requests)
     wall = time.monotonic() - t0
     if watchdog is not None:
         watchdog.stop()
     stats = mb.stats()
+    if request_log is not None:
+        request_log.close()
+        stats["request_log"] = str(request_log.root)
     stats["qps"] = stats["requests"] / wall if wall > 0 else float("inf")
     stats["programs"] = scorer.score_cache_size()
     stats["bundle"] = str(out_dir)

@@ -320,7 +320,14 @@ class DeltaPoller:
     The exporter drops chain entries next to each other
     (``<chain_root>/v000001`` …); the poller checks for the successor of the
     store's current version at most once per ``poll_s`` (the ``[serving]
-    swap_poll_s`` knob), injectable clock for tests."""
+    swap_poll_s`` knob), injectable clock for tests.
+
+    Clock robustness: ``poll_s <= 0`` degenerates to "always due" (poll
+    every tick) instead of arming a gate that floating-point drift could
+    wedge, and a BACKWARDS clock jump (NTP step, VM migration — the
+    injectable clock is not guaranteed monotonic) re-arms the deadline
+    relative to the new ``now`` rather than stalling until the old epoch is
+    reached again."""
 
     def __init__(self, chain_root: str | Path, *, poll_s: float = 1.0,
                  clock: Callable[[], float] = time.monotonic):
@@ -330,7 +337,16 @@ class DeltaPoller:
         self._next = self._clock()  # first poll is due immediately
 
     def due(self) -> bool:
+        if self.poll_s <= 0:
+            return True  # no cadence gate: every tick polls
         now = self._clock()
+        if now < self._next - self.poll_s:
+            # the clock jumped backwards: the stored deadline is unreachable
+            # garbage from the old epoch.  Re-arm one full interval out so
+            # the cadence contract (at most one poll per poll_s) holds in
+            # the new epoch instead of stalling for the jump's magnitude.
+            self._next = now + self.poll_s
+            return False
         if now < self._next:
             return False
         self._next = now + self.poll_s

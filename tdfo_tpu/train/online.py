@@ -1,0 +1,306 @@
+"""The online-learning supervisor: serve -> retrain -> delta-export -> swap.
+
+Monolith (§3.3) keeps CTR models fresh by feeding served traffic back into
+training and streaming parameter deltas to the serving fleet; torchrec's
+streaming-retrain loop is the same shape.  This module closes that loop for
+this repo: it tails the frontend's request log through the crash-safe
+``ReplayConsumer`` (``data/replay.py``), trains ``steps_per_cycle``
+incremental steps, persists the replay cursor as a checkpoint sidecar,
+exports a delta bundle (``serve/export.py:export_delta``), publishes it to
+the ``BundleStore`` and hot-swaps the in-process ``MicroBatcher`` — forever,
+or until the log drains / ``max_cycles``.
+
+Crash-safety is a single-durability-point design.  Each cycle runs stages
+
+    replay -> train -> checkpoint -> export -> publish -> swap
+
+and the CHECKPOINT is the only commit: state and replay cursor land
+atomically in one ``CheckpointManager.save`` (plus a ``target_version``
+claim for the store).  A kill before the checkpoint discards the cycle —
+the restart re-reads the same records from the last durable cursor and
+retrains them onto the matching restored state, so each record contributes
+to the state lineage exactly once.  A kill after the checkpoint but before
+the store caught up is repaired by ``_catch_up`` at startup: the store head
+still names a version below ``target_version``, so the supervisor re-exports
+the (deterministic) delta from the head to the checkpointed state and
+publishes it before entering the loop.  Either way "restart the same
+command" converges to the uninterrupted run's bundle, bit for bit — the
+property ``tests/test_online.py`` asserts with real ``os._exit`` kills at
+every stage boundary (``[faults] kill_between_stages`` /
+``kill_during_replay`` / ``kill_during_swap``).
+
+Stage boundaries consult ``FaultInjector.maybe_kill_stage`` so the kill
+matrix is deterministic, and every cycle logs an ``online_cycle`` record —
+consumed ``(seq, row_start, row_end)`` spans plus the ``replay/*`` counters
+— through the trainer's ``metrics.jsonl`` (PR-7 telemetry path), which is
+the record-id accounting the no-dup/no-loss test audits.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from tdfo_tpu.utils import faults as _faults
+
+__all__ = ["OnlineLoop", "online_from_config"]
+
+
+def _stage(name: str) -> None:
+    """A supervisor stage boundary: the deterministic kill-matrix hook.
+    The named stage has NOT run yet when the injected kill fires."""
+    inj = _faults.active()
+    if inj is not None:
+        inj.maybe_kill_stage(name)
+
+
+class OnlineLoop:
+    """One supervisor process: trainer + replay consumer + bundle store +
+    serving batcher, advancing in checkpointed cycles.
+
+    Restricted to the DMP/sparse CTR regime (DLRM, or TwoTower with
+    model_parallel): delta export diffs embedding tables, and online
+    freshness is an embedding-dominated problem (Monolith §3.3).
+    """
+
+    def __init__(self, config, *, log_dir: str | Path | None = None):
+        import jax
+
+        from tdfo_tpu.data.replay import ReplayConsumer
+        from tdfo_tpu.serve.swap import BundleStore
+        from tdfo_tpu.train.trainer import Trainer
+
+        if not config.online.request_log:
+            raise ValueError(
+                "the online loop needs [online] request_log — the directory "
+                "a serving frontend (serve --serving.log_features) wrote")
+        if config.model not in ("twotower", "dlrm"):
+            raise ValueError(
+                f"online supports the CTR family (twotower/dlrm), not "
+                f"{config.model!r}")
+        if jax.process_count() > 1:
+            raise ValueError(
+                "the online supervisor is single-process (one serving "
+                "replica owns its request log and bundle store)")
+        if config.steps_per_execution > 1:
+            raise ValueError(
+                "online requires steps_per_execution = 1: cycles are short "
+                "and the cursor commits per cycle, not per scan chunk")
+
+        self.config = config
+        self.trainer = Trainer(config, log_dir=log_dir)
+        if not hasattr(self.trainer.state, "tables"):
+            raise ValueError(
+                "online requires the DMP/sparse regime (dlrm, or twotower "
+                "with model_parallel) — delta export diffs embedding tables")
+        if self.trainer._pipelined:
+            raise ValueError(
+                "online does not support train.pipeline_overlap: the "
+                "checkpoint stage needs the cycle's updates flushed")
+        if self.trainer._ckpt is None:
+            raise ValueError("online requires checkpoint_dir")
+
+        self.workdir = Path(config.checkpoint_dir)
+        self.store = BundleStore(self.workdir / "bundle_store")
+        self.store.recover()  # half-published strays from a killed publish
+        self.chain = self.workdir / "delta_chain"
+        self.chain.mkdir(parents=True, exist_ok=True)
+
+        # restore: state + replay cursor land together, so a resumed process
+        # continues at the exact record the durable state has seen
+        self.gstep = 0
+        cursor: dict[str, Any] | None = None
+        if self.trainer._ckpt.latest_step() is not None:
+            self.gstep, self.trainer.state, cursor = self.trainer._ckpt.restore(
+                self.trainer.state, stamps=self.trainer._ckpt_stamps)
+        replay_cursor = (cursor or {}).get("replay")
+        self._claimed_version = int((cursor or {}).get("target_version") or 0)
+
+        mesh = self.trainer.mesh
+        self.consumer = ReplayConsumer(
+            config.online.request_log,
+            schema=self.trainer._eval_schema,
+            batch_size=config.per_device_train_batch_size
+            * mesh.shape["data"],
+            max_bad_records=config.online.max_bad_records,
+            max_lag_records=config.online.max_lag_records,
+            lag_policy=config.online.lag_policy,
+            cursor=replay_cursor,
+        )
+        self._bootstrap_store()
+        self._catch_up()
+        self.batcher = self._make_batcher()
+        self.cycles = 0
+
+    # ----------------------------------------------------------- store side
+
+    def _export_kwargs(self) -> dict[str, Any]:
+        from tdfo_tpu.train.trainer import _ctr_columns
+
+        cfg = self.config
+        cat_cols, cont_cols = _ctr_columns(cfg)
+        state = self.trainer.state
+        return dict(
+            model=cfg.model, embed_dim=cfg.embed_dim, cat_columns=cat_cols,
+            cont_columns=cont_cols, size_map=cfg.size_map, step=self.gstep,
+            coll=self.trainer.coll, tables=state.tables,
+            dense_params=state.dense_params,
+            mixed_precision=cfg.mixed_precision,
+        )
+
+    def _bootstrap_store(self) -> None:
+        """First launch: publish the current state as full bundle v0 so every
+        later cycle is a delta on a verified base.  Idempotent — a restart
+        that finds a store head skips this entirely."""
+        from tdfo_tpu.serve.export import export_bundle
+        from tdfo_tpu.serve.swap import _version_name
+
+        if self.store.current_version() is not None:
+            return
+        v0 = self.chain / _version_name(0)
+        if v0.exists():
+            shutil.rmtree(v0)  # crashed between export and ingest: redo
+        export_bundle(v0, version=0, **self._export_kwargs())
+        self.store.ingest_full(v0)
+
+    def _publish_state(self, target: int) -> None:
+        """Export the delta from the store head to the CURRENT trainer state
+        and publish it as ``target``.  Deterministic and redoable: a stale
+        half-exported directory is discarded and rebuilt from the same
+        state, and the store refuses to regress versions."""
+        from tdfo_tpu.serve.export import export_delta
+        from tdfo_tpu.serve.swap import _version_name
+
+        _stage("export")
+        delta_dir = self.chain / _version_name(target)
+        if delta_dir.exists():
+            shutil.rmtree(delta_dir)
+        export_delta(delta_dir, self.store.current_dir(),
+                     **self._export_kwargs())
+        _stage("publish")
+        self.store.apply_delta(delta_dir)  # kill_during_swap fires in here
+
+    def _catch_up(self) -> None:
+        """Repair a kill between checkpoint and publish: the checkpoint
+        claimed ``target_version`` but the store head is still behind it, so
+        the durable state has never reached serving.  Re-export + publish
+        before the loop — without this, a drained log would strand the last
+        trained cycle in the checkpoint forever."""
+        if self._claimed_version <= int(self.store.current_version() or 0):
+            return
+        self._publish_state(self._claimed_version)
+
+    def _make_batcher(self):
+        from tdfo_tpu.serve.frontend import MicroBatcher
+
+        spec = self.config.serving
+        scorer = self._build_scorer(self.store.current_dir())
+        return MicroBatcher(
+            scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
+            batch_deadline_ms=spec.batch_deadline_ms,
+            logger=self.trainer.logger,
+            program_cache_size=scorer.score_cache_size,
+            max_queue=spec.max_queue, shed_policy=spec.shed_policy,
+        )
+
+    def _build_scorer(self, bundle_dir):
+        from tdfo_tpu.serve.export import load_bundle
+        from tdfo_tpu.serve.scoring import make_scorer
+
+        return make_scorer(load_bundle(bundle_dir), mesh=self.trainer.mesh)
+
+    # ------------------------------------------------------------ the cycle
+
+    def _train_cycle(self, batches: list[dict[str, np.ndarray]]) -> float:
+        """Run one incremental step per replay batch.  Same step program as
+        offline fit — [online] adds no graph edits (jaxpr-pinned by
+        tests/test_online.py), so serving-loop configs never recompile."""
+        from jax.sharding import PartitionSpec as P
+
+        from tdfo_tpu.data.loader import prefetch_to_mesh
+        from tdfo_tpu.train.metrics import AUC
+
+        trainer, loss = self.trainer, 0.0
+        auc = AUC.empty() if trainer._train_auc_enabled else None
+        for batch in prefetch_to_mesh(iter(batches), trainer.mesh, P("data")):
+            out = trainer.train_step(trainer.state, batch, auc)
+            trainer.state, step_loss, auc = out[:3]
+            self.gstep += 1
+            loss = float(step_loss)
+        trainer._flush_cache_sync()  # update cache -> tables before export
+        return loss
+
+    def run_cycle(self) -> dict[str, Any] | None:
+        """One full serve->retrain->swap cycle; ``None`` when the durable
+        log has fewer than one batch of unread rows (drained)."""
+        cfg = self.config
+        _stage("replay")
+        self.consumer.check_backpressure()
+        batches, consumed = [], []
+        while len(batches) < cfg.online.steps_per_cycle:
+            out = self.consumer.next_batch()
+            if out is None:
+                break
+            batches.append(out[0])
+            consumed.extend(out[1])
+        if not batches:
+            return None
+
+        _stage("train")
+        loss = self._train_cycle(batches)
+
+        _stage("checkpoint")
+        target = int(self.store.current_version() or 0) + 1
+        self.trainer._ckpt.save(
+            self.gstep, self.trainer.state, force=True,
+            cursor={"online": True, "global_step": self.gstep,
+                    "replay": self.consumer.cursor(),
+                    "target_version": target},
+            stamps=self.trainer._ckpt_stamps)
+        self._claimed_version = target
+        rec = {
+            "event": "online_cycle", "cycle": self.cycles,
+            "global_step": self.gstep, "steps": len(batches),
+            "loss": loss, "version": target,
+            "consumed": [list(span) for span in consumed],
+            **self.consumer.counters(),
+        }
+        self.trainer.logger.log(**rec)
+
+        self._publish_state(target)  # stages: export -> publish
+
+        _stage("swap")
+        scorer = self._build_scorer(self.store.current_dir())
+        self.batcher.swap(scorer.score, version=target,
+                          program_cache_size=scorer.score_cache_size)
+        self.cycles += 1
+        return rec
+
+    def run(self) -> dict[str, Any]:
+        """Cycle until the log drains or ``max_cycles``; returns run stats."""
+        max_cycles = self.config.online.max_cycles
+        while not max_cycles or self.cycles < max_cycles:
+            if self.run_cycle() is None:
+                break
+        ctrs = self.consumer.counters()
+        return {
+            "cycles": self.cycles,
+            "global_step": self.gstep,
+            "version": int(self.store.current_version() or 0),
+            "bundle": str(self.store.current_dir()),
+            **ctrs,
+        }
+
+    def probe(self, requests) -> dict[Any, np.ndarray]:
+        """Score a request trace through the live (post-swap) batcher — the
+        served-logits fingerprint the bitwise-equality acceptance compares."""
+        return self.batcher.run(requests)
+
+
+def online_from_config(config, *, log_dir: str | Path | None = None
+                       ) -> dict[str, Any]:
+    """The ``python -m tdfo_tpu.launch online`` body."""
+    return OnlineLoop(config, log_dir=log_dir).run()

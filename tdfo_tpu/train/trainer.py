@@ -68,16 +68,21 @@ class MetricLogger:
     its closest analogue is tqdm bars + prints, SURVEY.md §5.5)."""
 
     def __init__(self, log_dir: str | Path | None = None,
-                 tensorboard: bool = False):
+                 tensorboard: bool = False, rotate_bytes: int = 0):
         self._f = None
         self._tb = None
         self._n = 0
+        # size-based rotation ([telemetry] log_rotate_bytes): a long-running
+        # online loop must not grow metrics.jsonl without bound
+        self._rotate_bytes = int(rotate_bytes)
+        self._path: Path | None = None
         # telemetry norm scalars accumulate here and flush as ONE histogram
         # summary per tag at close() (run-wide distribution view)
         self._hist_buf: dict[str, list[float]] = {}
         if log_dir is not None and jax.process_index() == 0:
             Path(log_dir).mkdir(parents=True, exist_ok=True)
-            self._f = open(Path(log_dir) / "metrics.jsonl", "a")
+            self._path = Path(log_dir) / "metrics.jsonl"
+            self._f = open(self._path, "a")
             if tensorboard:
                 # TF-free tfevents mirror of every scalar (the PS recipe's
                 # TensorBoard callback, tensorflow2/train_ps.py:154, made
@@ -105,6 +110,11 @@ class MetricLogger:
             if self._f is not None:
                 self._f.write(json.dumps(record) + "\n")
                 self._f.flush()
+                if self._rotate_bytes:
+                    from tdfo_tpu.utils.logrotate import maybe_rotate_file
+
+                    self._f = maybe_rotate_file(
+                        self._f, self._path, self._rotate_bytes)
             if self._tb is not None:
                 scalars = {
                     k: float(v) for k, v in record.items()
@@ -423,7 +433,8 @@ class Trainer:
             )
         self.mesh = make_mesh(config.mesh)
         self.logger = MetricLogger(log_dir or config.checkpoint_dir,
-                                   tensorboard=config.tensorboard)
+                                   tensorboard=config.tensorboard,
+                                   rotate_bytes=config.telemetry.log_rotate_bytes)
         self._ckpt = None
         self._ckpt_stamps = None  # compatibility stamps (hot/cold digests)
         self._logged_steps = 0  # run-global data-step counter (batches consumed)
@@ -437,7 +448,8 @@ class Trainer:
         # on other processes because MetricLogger made the dir on p0)
         out_dir = log_dir or config.checkpoint_dir
         if out_dir and jax.process_index() == 0:
-            _retry.set_failure_log(Path(out_dir) / "retries.jsonl")
+            _retry.set_failure_log(Path(out_dir) / "retries.jsonl",
+                                   rotate_bytes=config.telemetry.log_rotate_bytes)
         # arm (or clear) the process-global deterministic fault injector from
         # THIS config — the kill marker lives in checkpoint_dir so "restart
         # the same command" converges instead of crash-looping

@@ -39,6 +39,29 @@ MicroBatcher) key on OPERATION counts rather than data steps:
     recovery must survive.  One-shot per workdir via a
     ``faults_swap_kill.marker`` sentinel, like ``kill_at_step``.
 
+The replay / online-loop triggers (consulted by ``tdfo_tpu/data/replay.py``
+and ``tdfo_tpu/train/online.py``) exercise the request-log tail:
+
+  * ``truncate_log_at_byte = N``  — once, after the request-log writer's
+    append pushes the active segment to >= N bytes, the file is truncated
+    back to exactly N — a torn tail mid-record, the canonical crashed-writer
+    artifact the reader's last-good-offset recovery must survive.
+  * ``dup_record_nth = N``  — the Nth appended request record is written
+    twice (same ``seq``, once), so the reader's seq-dedup path runs against
+    a REAL duplicate, the retried-append artifact of an at-least-once writer.
+  * ``corrupt_record_nth = N``  — the Nth appended request record has its
+    payload bytes flipped before the newline (once), driving the
+    per-record quarantine (``max_bad_records``) on real garbage.
+  * ``kill_during_replay = N``  — hard-kill when the replay consumer
+    commits its Nth good record; one-shot per workdir via a
+    ``faults_replay_kill.marker`` sentinel.
+  * ``kill_between_stages = N``  — hard-kill at the Nth stage boundary the
+    online supervisor crosses (replay -> train -> checkpoint -> export ->
+    publish -> swap); one-shot per workdir via a
+    ``faults_stage_kill.marker`` sentinel.  Together with
+    ``kill_during_replay`` and ``kill_during_swap`` this covers every edge
+    of the serve -> retrain -> delta-export -> swap cycle.
+
 All training triggers key on run-global DATA position (batches consumed),
 which is monotone across rollbacks and resumes — ``state.step`` is not
 (rollback rewinds it).  Zero disables a trigger; a process with no faults
@@ -59,6 +82,8 @@ __all__ = ["FaultSpec", "FaultInjector", "configure", "active", "KILL_EXIT_CODE"
 KILL_EXIT_CODE = 17  # distinguishes an injected kill from real crashes
 _MARKER = "faults_kill.marker"
 _SWAP_MARKER = "faults_swap_kill.marker"
+_REPLAY_MARKER = "faults_replay_kill.marker"
+_STAGE_MARKER = "faults_stage_kill.marker"
 
 
 @dataclass(frozen=True)
@@ -74,11 +99,19 @@ class FaultSpec:
     corrupt_delta_nth: int = 0
     slow_score_ms: float = 0.0
     kill_during_swap: int = 0
+    truncate_log_at_byte: int = 0
+    dup_record_nth: int = 0
+    corrupt_record_nth: int = 0
+    kill_during_replay: int = 0
+    kill_between_stages: int = 0
 
     def __post_init__(self) -> None:
         for name in ("kill_at_step", "nan_at_step", "fail_io_nth",
                      "stall_at_step", "stall_seconds", "corrupt_delta_nth",
-                     "slow_score_ms", "kill_during_swap"):
+                     "slow_score_ms", "kill_during_swap",
+                     "truncate_log_at_byte", "dup_record_nth",
+                     "corrupt_record_nth", "kill_during_replay",
+                     "kill_between_stages"):
             if getattr(self, name) < 0:
                 raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
 
@@ -86,7 +119,9 @@ class FaultSpec:
         return bool(self.kill_at_step or self.nan_at_step
                     or self.fail_io_nth or self.stall_at_step
                     or self.corrupt_delta_nth or self.slow_score_ms
-                    or self.kill_during_swap)
+                    or self.kill_during_swap or self.truncate_log_at_byte
+                    or self.dup_record_nth or self.corrupt_record_nth
+                    or self.kill_during_replay or self.kill_between_stages)
 
 
 class FaultInjector:
@@ -101,6 +136,12 @@ class FaultInjector:
         self._delta_count = 0
         self._delta_fired = False
         self._swap_count = 0
+        self._truncate_fired = False
+        self._dup_count = 0
+        self._dup_fired = False
+        self._rec_corrupt_count = 0
+        self._rec_corrupt_fired = False
+        self._stage_count = 0
 
     # ------------------------------------------------------------- kill
 
@@ -209,6 +250,94 @@ class FaultInjector:
             )
         print(f"[faults] injected kill during swap apply #{self._swap_count}",
               flush=True)
+        os._exit(KILL_EXIT_CODE)
+
+    # ------------------------------------------------------------ replay
+
+    def truncate_log_due(self, segment_bytes: int) -> bool:
+        """Called by the request-log writer after each flushed append with
+        the active segment's current size.  True exactly once, the first
+        time the size reaches ``truncate_log_at_byte`` — the caller then
+        truncates the file back to that byte, tearing the tail record."""
+        if (not self.spec.truncate_log_at_byte or self._truncate_fired
+                or segment_bytes < self.spec.truncate_log_at_byte):
+            return False
+        self._truncate_fired = True
+        print(f"[faults] truncating request log at byte "
+              f"{self.spec.truncate_log_at_byte}", flush=True)
+        return True
+
+    def dup_record_due(self) -> bool:
+        """Called by the request-log writer once per appended record.  True
+        exactly once, on the configured Nth append — the caller then writes
+        the same line (same seq) a second time."""
+        if not self.spec.dup_record_nth or self._dup_fired:
+            return False
+        self._dup_count += 1
+        if self._dup_count == self.spec.dup_record_nth:
+            self._dup_fired = True
+            print(f"[faults] duplicating request record #{self._dup_count}",
+                  flush=True)
+            return True
+        return False
+
+    def corrupt_record_due(self) -> bool:
+        """Called by the request-log writer once per appended record.  True
+        exactly once, on the configured Nth append — the caller then flips
+        payload bytes so the reader sees real garbage on a sealed line."""
+        if not self.spec.corrupt_record_nth or self._rec_corrupt_fired:
+            return False
+        self._rec_corrupt_count += 1
+        if self._rec_corrupt_count == self.spec.corrupt_record_nth:
+            self._rec_corrupt_fired = True
+            print(f"[faults] corrupting request record "
+                  f"#{self._rec_corrupt_count}", flush=True)
+            return True
+        return False
+
+    def replay_kill_due(self, n_committed: int) -> bool:
+        """True when the replay-commit kill should fire (``n_committed``
+        good records committed so far); honours the one-shot marker."""
+        if (not self.spec.kill_during_replay
+                or n_committed < self.spec.kill_during_replay):
+            return False
+        if self.workdir is not None and (self.workdir / _REPLAY_MARKER).exists():
+            return False
+        return True
+
+    def maybe_kill_replay(self, n_committed: int) -> None:
+        """Hard-exit when the replay consumer commits its Nth good record —
+        the restart must resume from the persisted cursor with no dup/loss."""
+        if not self.replay_kill_due(n_committed):
+            return
+        if self.workdir is not None:
+            self.workdir.mkdir(parents=True, exist_ok=True)
+            (self.workdir / _REPLAY_MARKER).write_text(
+                f"killed at replay record {n_committed} at {time.time()}\n"
+            )
+        print(f"[faults] injected kill at replay record {n_committed}",
+              flush=True)
+        os._exit(KILL_EXIT_CODE)
+
+    def maybe_kill_stage(self, stage: str) -> None:
+        """Hard-exit at the Nth online-supervisor stage boundary crossed
+        (one-shot per workdir) — the named stage has NOT run yet, so the
+        restart must redo it idempotently from the persisted cursors."""
+        if not self.spec.kill_between_stages:
+            return
+        if self.workdir is not None and (self.workdir / _STAGE_MARKER).exists():
+            return
+        self._stage_count += 1
+        if self._stage_count != self.spec.kill_between_stages:
+            return
+        if self.workdir is not None:
+            self.workdir.mkdir(parents=True, exist_ok=True)
+            (self.workdir / _STAGE_MARKER).write_text(
+                f"killed before stage {stage!r} (boundary "
+                f"#{self._stage_count}) at {time.time()}\n"
+            )
+        print(f"[faults] injected kill before stage {stage!r} (boundary "
+              f"#{self._stage_count})", flush=True)
         os._exit(KILL_EXIT_CODE)
 
     # --------------------------------------------------------------- io

@@ -1,0 +1,61 @@
+"""Size-based rotation for the run's append-only JSONL sinks.
+
+A long-running online loop (serve -> retrain -> swap, ``train/online.py``)
+writes ``metrics.jsonl`` and ``retries.jsonl`` forever; without a cap they
+fill the disk.  Rotation here is deliberately minimal and crash-safe: the
+current file is CLOSED (every record complete — the writers flush per line)
+and atomically renamed to ``<name>.1``, replacing the previous overflow, and
+a fresh file continues under the original name.  A crash at any byte leaves
+either the old complete file or the renamed complete file — never a torn
+one.  One generation of history is the contract (these are diagnostics
+sinks, not durable state; durable state lives in checkpoints and bundles).
+
+:func:`rotate_path` is a sanctioned rename site in
+``tests/test_quality.py``'s bare-rename rule: the rename operates on a
+closed, complete file, so the fsync-file + fsync-dir discipline of
+``serve/swap.py``'s helpers (which protect half-WRITTEN payloads) adds
+nothing here.
+
+The request log is NOT rotated here — ``data/replay.py``'s ``RequestLog``
+owns its segment chain, which must seal digests rather than discard.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import IO
+
+__all__ = ["rotate_path", "maybe_rotate_path", "maybe_rotate_file"]
+
+
+def rotate_path(path: Path) -> None:
+    """Atomically retire a closed, complete JSONL file to ``<name>.1``."""
+    path = Path(path)
+    os.replace(path, path.with_name(path.name + ".1"))
+
+
+def maybe_rotate_path(path: str | Path, rotate_bytes: int) -> bool:
+    """Rotate a closed-between-appends sink (the ``retries.jsonl`` shape)
+    once it reaches ``rotate_bytes``.  Returns whether it rotated."""
+    if not rotate_bytes:
+        return False
+    path = Path(path)
+    try:
+        if path.stat().st_size < rotate_bytes:
+            return False
+    except OSError:
+        return False
+    rotate_path(path)
+    return True
+
+
+def maybe_rotate_file(f: IO[str], path: str | Path, rotate_bytes: int) -> IO[str]:
+    """Rotate an open append handle (the ``metrics.jsonl`` shape) once its
+    write position reaches ``rotate_bytes``.  Returns the handle to keep
+    writing to — the original, or a fresh one after rotation."""
+    if not rotate_bytes or f.tell() < rotate_bytes:
+        return f
+    f.close()
+    rotate_path(Path(path))
+    return open(path, "a")

@@ -33,13 +33,18 @@ __all__ = ["backoff_delay", "retry_call", "retryable", "set_failure_log",
 # log file is configured
 _RECENT: collections.deque = collections.deque(maxlen=256)
 _LOG_PATH: Path | None = None
+_ROTATE_BYTES: int = 0
 
 
-def set_failure_log(path: str | Path | None) -> None:
+def set_failure_log(path: str | Path | None, *, rotate_bytes: int = 0) -> None:
     """Route failure records to a JSONL file (``None`` disables).  The
-    trainer points this at ``<log_dir>/retries.jsonl`` on process 0."""
-    global _LOG_PATH
+    trainer points this at ``<log_dir>/retries.jsonl`` on process 0.
+    ``rotate_bytes`` > 0 retires the file to ``retries.jsonl.1`` once it
+    reaches that size (``[telemetry] log_rotate_bytes``) so a long-running
+    online loop cannot fill the disk with retry diagnostics."""
+    global _LOG_PATH, _ROTATE_BYTES
     _LOG_PATH = Path(path) if path is not None else None
+    _ROTATE_BYTES = int(rotate_bytes)
 
 
 def recent_failures() -> list[dict[str, Any]]:
@@ -54,6 +59,9 @@ def _record(rec: dict[str, Any]) -> None:
             _LOG_PATH.parent.mkdir(parents=True, exist_ok=True)
             with open(_LOG_PATH, "a") as f:
                 f.write(json.dumps(rec) + "\n")
+            from tdfo_tpu.utils.logrotate import maybe_rotate_path
+
+            maybe_rotate_path(_LOG_PATH, _ROTATE_BYTES)
         except OSError:
             pass  # the failure log must never turn a retry into a crash
 

@@ -1,0 +1,100 @@
+"""Worker for the online-loop kill/restart tests (run as a subprocess,
+NOT pytest).
+
+Usage:
+    python online_worker.py <spec_json_path>
+
+Spec keys: ``data_dir``, ``checkpoint_dir``, ``log_dir``, ``request_log``,
+``out_json``, ``local_devices``, ``steps_per_cycle``, ``max_cycles``,
+``max_bad_records``, ``max_lag_records``, ``lag_policy``, ``faults`` (a
+``[faults]`` dict — kill_during_replay / kill_between_stages /
+kill_during_swap), ``probe_seed``.
+
+Spoofs CPU devices and runs the REAL ``OnlineLoop`` (``train/online.py``)
+against a request log the parent test wrote with the real ``RequestLog``
+writer.  On completion it scores a deterministic probe trace through the
+live post-swap ``MicroBatcher`` and writes the verdict to ``out_json``:
+final store version, the composed bundle's manifest digest, the replay
+cursor, and the served probe logits.  When an injected kill fires, the
+process dies via ``os._exit(KILL_EXIT_CODE)`` and writes nothing — exactly
+a crashed supervisor.  Restarting the SAME spec must converge to a verdict
+bitwise-equal to an uninterrupted run's (tests/test_online.py asserts it).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+
+def main() -> None:
+    spec = json.loads(Path(sys.argv[1]).read_text())
+
+    from tdfo_tpu.core.mesh import spoof_cpu_devices
+
+    spoof_cpu_devices(int(spec.get("local_devices", 8)))
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import numpy as np
+
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.serve.export import read_raw_bundle
+    from tdfo_tpu.serve.frontend import _column_vocab
+    from tdfo_tpu.train.online import OnlineLoop
+    from tdfo_tpu.train.trainer import _ctr_columns
+
+    cfg = read_configs(
+        None,
+        data_dir=spec["data_dir"],
+        model="twotower",
+        model_parallel=True,
+        n_epochs=1,
+        learning_rate=3e-3,
+        embed_dim=8,
+        per_device_train_batch_size=8,
+        per_device_eval_batch_size=8,
+        shuffle_buffer_size=500,
+        log_every_n_steps=1000,
+        size_map=load_size_map(spec["data_dir"]),
+        checkpoint_dir=spec["checkpoint_dir"],
+        faults=dict(spec.get("faults") or {}),
+        online=dict(
+            request_log=spec["request_log"],
+            steps_per_cycle=int(spec.get("steps_per_cycle", 2)),
+            max_cycles=int(spec.get("max_cycles", 0)),
+            max_bad_records=int(spec.get("max_bad_records", 0)),
+            max_lag_records=int(spec.get("max_lag_records", 0)),
+            lag_policy=spec.get("lag_policy", "fail"),
+        ),
+    )
+    loop = OnlineLoop(cfg, log_dir=spec["log_dir"])
+    stats = loop.run()
+
+    # deterministic probe trace through the live (post-swap) batcher: the
+    # served-logits fingerprint the bitwise acceptance compares
+    cat_cols, cont_cols = _ctr_columns(cfg)
+    vocab = _column_vocab(cfg, cat_cols)
+    rng = np.random.default_rng(int(spec.get("probe_seed", 606)))
+    requests = []
+    for i, n in enumerate((3, 5, 2, 8)):
+        batch = {c: rng.integers(0, vocab[c], size=n, dtype=np.int32)
+                 for c in cat_cols}
+        for c in cont_cols:
+            batch[c] = rng.random(n, dtype=np.float32)
+        requests.append((f"probe{i}", batch))
+    results = loop.probe(requests)
+
+    manifest, _ = read_raw_bundle(loop.store.current_dir())
+    Path(spec["out_json"]).write_text(json.dumps({
+        "stats": stats,
+        "version": int(loop.store.current_version()),
+        "digest": manifest["digest"],
+        "cursor": loop.consumer.cursor(),
+        "logits": {rid: np.asarray(v).tolist() for rid, v in results.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

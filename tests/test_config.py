@@ -495,3 +495,91 @@ def test_serving_resilience_knobs(tmp_path: Path):
                               max_bad_deltas=threshold)
         assert ctrl.apply(tmp_path / "d") is False
         assert ctrl.degraded is after_one
+
+
+def test_online_table(tmp_path: Path):
+    """The [online] supervisor table: defaults, toml round-trip, unknown-key
+    rejection, and the crash-safety coupling to checkpoint_dir."""
+    from tdfo_tpu.core.config import OnlineSpec
+
+    cfg = read_configs()
+    assert cfg.online.request_log == ""  # off by default
+    assert cfg.online.steps_per_cycle == 8
+    assert cfg.online.max_cycles == 0  # drain mode
+    assert cfg.online.max_bad_records == 0
+    assert cfg.online.max_lag_records == 0  # unbounded lag
+    assert cfg.online.lag_policy == "fail"
+
+    (tmp_path / "config.toml").write_text(
+        "checkpoint_dir = \"ckpt\"\n"
+        "[online]\nrequest_log = \"rl\"\nsteps_per_cycle = 4\n"
+        "max_cycles = 2\nmax_bad_records = 3\nmax_lag_records = 100\n"
+        "lag_policy = \"skip\"\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.online.request_log == "rl"
+    assert cfg.online.steps_per_cycle == 4
+    assert cfg.online.max_cycles == 2
+    assert cfg.online.max_bad_records == 3
+    assert cfg.online.max_lag_records == 100
+    assert cfg.online.lag_policy == "skip"
+
+    (tmp_path / "config.toml").write_text("[online]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="bogus"):
+        read_configs(tmp_path / "config.toml")
+
+    for bad, match in (
+        (dict(steps_per_cycle=0), "steps_per_cycle"),
+        (dict(max_cycles=-1), "max_cycles"),
+        (dict(max_bad_records=-1), "max_bad_records"),
+        (dict(max_lag_records=-1), "max_lag_records"),
+        (dict(lag_policy="drop"), "lag_policy"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(online=OnlineSpec(**bad))
+    # the replay cursor persists as a checkpoint sidecar: a request_log
+    # without checkpoint_dir cannot be crash-safe and is refused
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Config(online=OnlineSpec(request_log="rl"))
+    Config(online=OnlineSpec(request_log="rl"), checkpoint_dir="ckpt")
+
+
+def test_request_log_and_rotation_knobs(tmp_path: Path):
+    """[serving] log_features/log_segment_bytes + [telemetry]
+    log_rotate_bytes: round-trip, rejections, and coupling."""
+    from tdfo_tpu.core.config import ServingSpec, TelemetrySpec
+
+    cfg = read_configs()
+    assert cfg.serving.log_features is False
+    assert cfg.serving.log_segment_bytes == 0
+    assert cfg.telemetry.log_rotate_bytes == 0
+
+    (tmp_path / "config.toml").write_text(
+        "[serving]\nlog_features = true\nlog_segment_bytes = 65536\n"
+        "[telemetry]\nlog_rotate_bytes = 1048576\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.serving.log_features is True
+    assert cfg.serving.log_segment_bytes == 65536
+    assert cfg.telemetry.log_rotate_bytes == 1048576
+
+    with pytest.raises(ValueError, match="log_segment_bytes"):
+        Config(serving=ServingSpec(log_features=True, log_segment_bytes=-1))
+    # rotation without the replayable log is a dead knob -> refused
+    with pytest.raises(ValueError, match="log_features"):
+        Config(serving=ServingSpec(log_segment_bytes=4096))
+    with pytest.raises(ValueError, match="log_rotate_bytes"):
+        Config(telemetry=TelemetrySpec(log_rotate_bytes=-1))
+
+
+def test_replay_fault_triggers_table(tmp_path: Path):
+    """The PR-10 [faults] triggers round-trip like the existing ones."""
+    (tmp_path / "config.toml").write_text(
+        "[faults]\ntruncate_log_at_byte = 100\ndup_record_nth = 2\n"
+        "corrupt_record_nth = 3\nkill_during_replay = 4\n"
+        "kill_between_stages = 5\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.faults.truncate_log_at_byte == 100
+    assert cfg.faults.dup_record_nth == 2
+    assert cfg.faults.corrupt_record_nth == 3
+    assert cfg.faults.kill_during_replay == 4
+    assert cfg.faults.kill_between_stages == 5
+    assert cfg.faults.any()

@@ -1,0 +1,278 @@
+"""Online-loop acceptance: kill the serve->retrain->delta-export->swap
+supervisor (``train/online.py``) at stage boundaries with REAL ``os._exit``
+kills, restart the same command, and require the final swapped bundle —
+digest, replay cursor, AND served probe logits — bitwise-equal to an
+uninterrupted run's (subprocess pattern from tests/test_crash_resume.py).
+
+The request log is written ONCE by the module fixture with the real
+``RequestLog`` writer (rotation on), so every lineage replays the same
+bytes.  Kill/restart runs use drain mode (``max_cycles = 0``): the
+in-memory cycle counter resets on restart, so only "consume the whole log"
+is comparable across lineages.
+
+Tier 1 runs ONE kill (cycle-2 export boundary — after the checkpoint
+claimed ``target_version``, before the store caught up, i.e. the
+``_catch_up`` repair path) plus the record-id accounting and jaxpr audits;
+the full kill matrix is ``@pytest.mark.slow``.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = str(Path(__file__).resolve().parents[1])
+WORKER = str(Path(__file__).with_name("online_worker.py"))
+
+LOCAL_DEVICES = 4
+BATCH_ROWS = 8 * 4  # per_device_train_batch_size x data-axis size
+STEPS_PER_CYCLE = 2
+N_CYCLES = 2  # full cycles the log holds (plus a sub-batch tail that waits)
+
+
+def _spawn(spec_path: Path) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(spec_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _run_workers(spec_paths: list[Path]) -> tuple[list[int], list[str]]:
+    procs = [_spawn(p) for p in spec_paths]
+    rcs, outs = [], []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            rcs.append(p.returncode)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    return rcs, outs
+
+
+def _run_worker(spec_path: Path) -> tuple[int, str]:
+    rcs, outs = _run_workers([spec_path])
+    return rcs[0], outs[0]
+
+
+@pytest.fixture(scope="module")
+def online_env(tmp_path_factory):
+    """Synthetic goodreads data + a request log every lineage replays."""
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.replay import RequestLog
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.serve.frontend import _column_vocab
+    from tdfo_tpu.train.trainer import _ctr_columns
+
+    d = tmp_path_factory.mktemp("gr_online")
+    write_synthetic_goodreads(d, n_users=80, n_books=120,
+                              interactions_per_user=(15, 40), seed=13)
+    run_ctr_preprocessing(d)
+
+    cfg = read_configs(None, data_dir=str(d), model="twotower",
+                       model_parallel=True, size_map=load_size_map(str(d)))
+    cat_cols, cont_cols = _ctr_columns(cfg)
+    vocab = _column_vocab(cfg, cat_cols)
+
+    root = tmp_path_factory.mktemp("reqlog") / "rl"
+    log = RequestLog(root, segment_bytes=4096)  # rotation in the real stream
+    rng = np.random.default_rng(7)
+    rows_by_seq: dict[int, int] = {}
+    total, target = 0, N_CYCLES * STEPS_PER_CYCLE * BATCH_ROWS
+    while total < target + 5:  # sub-batch tail: drained runs leave it unread
+        n = int(rng.integers(3, 9))
+        feats = {c: rng.integers(0, vocab[c], size=n).tolist()
+                 for c in cat_cols}
+        for c in cont_cols:
+            feats[c] = [round(float(v), 6) for v in rng.random(n)]
+        feats["label"] = rng.integers(0, 2, size=n).tolist()
+        seq = log.append({"event": "serve_request", "request": f"r{total}",
+                          "rows": n, "outcome": "ok", "features": feats})
+        rows_by_seq[seq] = n
+        total += n
+    log.close()
+    return dict(data_dir=str(d), request_log=str(root),
+                rows_by_seq=rows_by_seq, total_rows=total)
+
+
+def _make_spec(tmp: Path, env: dict, name: str, *, ckpt: str, log: str,
+               faults: dict | None = None) -> Path:
+    spec = dict(
+        data_dir=env["data_dir"], checkpoint_dir=str(tmp / ckpt),
+        log_dir=str(tmp / log), request_log=env["request_log"],
+        out_json=str(tmp / f"{name}.json"), local_devices=LOCAL_DEVICES,
+        steps_per_cycle=STEPS_PER_CYCLE, max_cycles=0,
+        faults=faults or {},
+    )
+    p = tmp / f"{name}_spec.json"
+    p.write_text(json.dumps(spec))
+    return p
+
+
+@pytest.fixture(scope="module")
+def kill_runs(online_env, tmp_path_factory):
+    """The tier-1 scenario, run once for all audits below: kill at the
+    cycle-2 EXPORT boundary (stage-call #10 — the checkpoint has claimed
+    target_version 2 but the store head is still v1), restart, plus an
+    uninterrupted reference lineage."""
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    tmp = tmp_path_factory.mktemp("online_runs")
+    killed_p = _make_spec(tmp, online_env, "killed", ckpt="ckpt",
+                          log="log_shared",
+                          faults={"kill_between_stages": 10})
+    ref_p = _make_spec(tmp, online_env, "ref", ckpt="ckpt_ref", log="log_ref")
+
+    # killed and reference lineages are independent: run them concurrently
+    rcs, outs = _run_workers([killed_p, ref_p])
+    assert rcs[0] == KILL_EXIT_CODE, \
+        f"expected injected kill, got rc={rcs[0]}\n{outs[0][-2000:]}"
+    assert not (tmp / "killed.json").exists()  # died before the verdict
+    assert (tmp / "ckpt" / "faults_stage_kill.marker").exists()
+    assert rcs[1] == 0, f"reference run failed rc={rcs[1]}\n{outs[1][-2000:]}"
+
+    # restart the SAME command: the marker disarms the kill, _catch_up
+    # publishes the claimed version, the loop drains the log
+    rc, out = _run_worker(killed_p)
+    assert rc == 0, f"resumed run failed rc={rc}\n{out[-2000:]}"
+
+    return dict(
+        resumed=json.loads((tmp / "killed.json").read_text()),
+        ref=json.loads((tmp / "ref.json").read_text()),
+        metrics=tmp / "log_shared" / "metrics.jsonl",
+        tmp=tmp,
+    )
+
+
+def test_kill_restart_converges_bitwise(kill_runs):
+    resumed, ref = kill_runs["resumed"], kill_runs["ref"]
+    # same store version, same composed-bundle digest, same replay cursor
+    assert resumed["version"] == ref["version"] >= N_CYCLES
+    assert resumed["digest"] == ref["digest"]
+    assert resumed["cursor"] == ref["cursor"]
+    # the servable surface: probe logits through the live post-swap batcher
+    # are bitwise-equal (json round-trips floats exactly)
+    assert resumed["logits"] == ref["logits"]
+    assert resumed["stats"]["global_step"] == ref["stats"]["global_step"]
+
+
+def _online_cycles(metrics_path: Path) -> list[dict]:
+    recs = [json.loads(l) for l in metrics_path.read_text().splitlines()]
+    return [r for r in recs if r.get("event") == "online_cycle"]
+
+
+def test_record_accounting_no_dup_no_loss(kill_runs, online_env):
+    """The exactly-once audit: killed + resumed lineages share one
+    metrics.jsonl; across BOTH, the consumed (seq, row_start, row_end)
+    spans of the durable cycles tile each record exactly once."""
+    cycles = _online_cycles(kill_runs["metrics"])
+    assert len(cycles) >= N_CYCLES
+    # each durable cycle published exactly one store version, no repeats
+    versions = [c["version"] for c in cycles]
+    assert versions == sorted(set(versions))
+
+    spans: dict[int, list[tuple[int, int]]] = {}
+    for c in cycles:
+        for seq, a, b in c["consumed"]:
+            spans.setdefault(seq, []).append((a, b))
+    rows_by_seq = {int(k): v for k, v in online_env["rows_by_seq"].items()}
+    covered = 0
+    for seq, parts in spans.items():
+        parts.sort()
+        # no overlap (trained twice) and no hole (skipped) within a record
+        assert parts[0][0] == 0, (seq, parts)
+        for (a0, b0), (a1, b1) in zip(parts, parts[1:]):
+            assert b0 == a1, f"seq {seq}: gap or overlap at {parts}"
+        assert parts[-1][1] <= rows_by_seq[seq]
+        covered += parts[-1][1] == rows_by_seq[seq]
+    # fully-trained records match the durable cursor's record count
+    assert covered == kill_runs["resumed"]["cursor"]["records"]
+
+
+def test_replay_counters_ride_telemetry(kill_runs):
+    """Acceptance: replay/records, replay/bad, replay/lag are visible
+    through the PR-7 metrics path on every cycle record."""
+    cycles = _online_cycles(kill_runs["metrics"])
+    assert cycles
+    for c in cycles:
+        assert c["replay/records"] >= 1.0
+        assert c["replay/bad"] == 0.0
+        assert c["replay/lag"] >= 0.0
+    # monotone progress across the shared log: records never regress
+    recs = [c["replay/records"] for c in cycles]
+    assert recs == sorted(recs)
+
+
+def test_online_config_does_not_touch_step_graph(online_env, tmp_path):
+    """Acceptance jaxpr pin: a loop config with replay disabled vs enabled
+    compiles byte-identical step programs — [online] is pure supervisor
+    plumbing, it cannot cost a single equation in the hot path."""
+    import jax
+
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.train.metrics import AUC
+    from tdfo_tpu.train.trainer import Trainer
+
+    kw = dict(data_dir=online_env["data_dir"], model="twotower",
+              model_parallel=True, n_epochs=1, embed_dim=8,
+              per_device_train_batch_size=8,
+              size_map=load_size_map(online_env["data_dir"]))
+    cfg_off = read_configs(None, **kw)
+    cfg_on = read_configs(
+        None, checkpoint_dir=str(tmp_path / "ckpt"),
+        online=dict(request_log=online_env["request_log"]), **kw)
+
+    norm = lambda j: re.sub(r"0x[0-9a-f]+", "0xADDR", str(j))
+    jaxprs = []
+    for cfg in (cfg_off, cfg_on):
+        tr = Trainer(cfg)
+        batch = {k: np.zeros((8 * tr.mesh.shape["data"],) + shape, dt)
+                 for k, (dt, shape) in tr._eval_schema.items()}
+        auc = AUC.empty() if tr._train_auc_enabled else None
+        jaxprs.append(norm(jax.make_jaxpr(tr.train_step)(
+            tr.state, batch, auc)))
+    assert jaxprs[0] == jaxprs[1]
+
+
+@pytest.mark.slow  # the full kill matrix; tier 1 covers the catch-up kill
+@pytest.mark.parametrize("faults", [
+    {"kill_between_stages": 1},   # cycle 1 replay: nothing durable yet
+    {"kill_between_stages": 2},   # cycle 1 train: replay cursor uncommitted
+    {"kill_between_stages": 3},   # before cycle-1 checkpoint: cycle discarded
+    {"kill_between_stages": 4},   # after checkpoint, before export
+    {"kill_between_stages": 5},   # delta exported, not published
+    {"kill_between_stages": 6},   # published, serving swap never ran
+    {"kill_during_replay": 2},    # mid-replay, after a record's commit
+    {"kill_during_swap": 1},      # mid-apply_delta: half-published store
+], ids=lambda f: "-".join(f"{k}{v}" for k, v in f.items()))
+def test_kill_matrix_converges(kill_runs, online_env, tmp_path, faults):
+    """Kill at EVERY stage boundary of cycle 1 (plus mid-replay and
+    mid-publish): restarting the same command must always converge to the
+    reference verdict, bit for bit."""
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    spec = _make_spec(tmp_path, online_env, "killed", ckpt="ckpt",
+                      log="log", faults=faults)
+    rc, out = _run_worker(spec)
+    assert rc == KILL_EXIT_CODE, f"rc={rc}\n{out[-2000:]}"
+    assert not (tmp_path / "killed.json").exists()
+
+    rc, out = _run_worker(spec)
+    assert rc == 0, f"resumed run failed rc={rc}\n{out[-2000:]}"
+    resumed = json.loads((tmp_path / "killed.json").read_text())
+    ref = kill_runs["ref"]
+    assert resumed["version"] == ref["version"]
+    assert resumed["digest"] == ref["digest"]
+    assert resumed["cursor"] == ref["cursor"]
+    assert resumed["logits"] == ref["logits"]

@@ -296,8 +296,11 @@ def test_no_bare_renames_outside_atomic_swap_helpers():
     fsync-file + replace + fsync-dir discipline, and a crash at that site
     leaves a torn pointer or a half-published bundle
     (``tdfo_tpu/serve/swap.py`` docstring).  The ONLY sanctioned sites are
-    ``atomic_write_json`` and ``publish_dir`` there.  Keyworded ``.rename``
-    calls (pandas column renames) are host-side and exempt."""
+    ``atomic_write_json`` and ``publish_dir`` there, plus
+    ``utils/logrotate.py``'s ``rotate_path`` (which renames a CLOSED,
+    complete diagnostics file — nothing half-written to protect).
+    Keyworded ``.rename`` calls (pandas column renames) are host-side and
+    exempt."""
     import ast
     from pathlib import Path
 
@@ -305,7 +308,8 @@ def test_no_bare_renames_outside_atomic_swap_helpers():
 
     root = Path(tdfo_tpu.__file__).parent
     SANCTIONED = {("serve/swap.py", "atomic_write_json"),
-                  ("serve/swap.py", "publish_dir")}
+                  ("serve/swap.py", "publish_dir"),
+                  ("utils/logrotate.py", "rotate_path")}
 
     offenders, sanctioned_hits = [], 0
     for path in sorted(root.rglob("*.py")):
@@ -341,10 +345,11 @@ def test_no_bare_renames_outside_atomic_swap_helpers():
                 sanctioned_hits += 1
                 continue
             offenders.append(f"{path}:{node.lineno}")
-    assert sanctioned_hits >= 2  # the scanner sees both blessed helpers
+    assert sanctioned_hits >= 3  # the scanner sees every blessed helper
     assert not offenders, (
         "bare rename outside serve/swap.py's atomic helpers (not crash-"
-        "safe — route through atomic_write_json/publish_dir): "
+        "safe — route through atomic_write_json/publish_dir, or "
+        "logrotate.rotate_path for closed diagnostics files): "
         + ", ".join(offenders))
 
 
@@ -399,4 +404,64 @@ def test_no_hand_rolled_retry_sleep_loops():
     assert not offenders, (
         "hand-rolled time.sleep retry loop (use utils/retry.py retry_call: "
         "bounded attempts, jittered backoff, JSONL records, fault hook): "
+        + ", ".join(offenders))
+
+
+def test_no_adhoc_jsonl_tailers():
+    """``data/replay.py`` is the single sanctioned reader of line-oriented
+    JSONL streams: it owns torn-tail truncation, seal digest verification,
+    seq dedup and the byte-offset cursor that make replay exactly-once.  A
+    hand-rolled ``for line in ...: json.loads(line)`` tailer anywhere else
+    silently skips ALL of that — it would happily train on a torn or
+    corrupted log.  The detector flags any ``json.loads`` call lexically
+    inside a ``for``/``while`` loop in the package, outside the blessed
+    readers: ``data/replay.py`` itself and ``plan/stats.py`` (which streams
+    its OWN stats artifact, written atomically as a complete file — not a
+    live log).  Whole-file ``json.loads(path.read_text())`` reads are
+    loop-free and stay legal.  Self-tested on a synthetic offender."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    BLESSED = {"data/replay.py", "plan/stats.py"}
+
+    def loop_loads_lines(tree):
+        hits = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for n in ast.walk(node):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "loads"
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "json"):
+                    hits.append(n.lineno)
+        return sorted(set(hits))
+
+    synthetic = (
+        "import json\n"
+        "def tail(path):\n"
+        "    out = []\n"
+        "    for line in open(path):\n"
+        "        out.append(json.loads(line))\n"
+        "    return out\n")
+    assert loop_loads_lines(ast.parse(synthetic)) == [5]
+
+    offenders, blessed_hits = [], 0
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        lines = loop_loads_lines(ast.parse(path.read_text(),
+                                           filename=str(path)))
+        if rel in BLESSED:
+            blessed_hits += len(lines)
+            continue
+        offenders += [f"{path}:{ln}" for ln in lines]
+    assert blessed_hits > 0  # the scanner sees the sanctioned reader
+    assert not offenders, (
+        "ad-hoc JSONL line tailer (json.loads inside a loop) outside "
+        "data/replay.py — it bypasses torn-tail recovery, seal digests and "
+        "the exactly-once cursor; read through ReplayConsumer: "
         + ", ".join(offenders))

@@ -362,6 +362,38 @@ def test_poller_cadence_and_discovery(tmp_path):
     assert p.next_delta(1) is None
 
 
+def test_poller_backwards_clock_jump_rearms(tmp_path):
+    """An NTP step / VM migration moves the injectable clock BACKWARDS: the
+    poller must re-arm relative to the new epoch, not stall until the old
+    deadline is reached again (hours of frozen swaps)."""
+    now = [1000.0]
+    p = DeltaPoller(tmp_path, poll_s=2.0, clock=lambda: now[0])
+    assert p.due() is True
+    now[0] = 100.0  # 900 s backwards; old deadline 1002.0 is unreachable
+    assert p.due() is False  # the jump tick re-arms, it does not fire
+    now[0] = 101.9
+    assert p.due() is False  # cadence contract holds in the new epoch
+    now[0] = 102.0
+    assert p.due() is True  # ...and polling resumes one interval later
+    # a small backwards wobble (< one interval) is NOT a jump: the armed
+    # deadline stays valid and fires on schedule
+    now[0] = 101.0
+    assert p.due() is False
+    now[0] = 104.0
+    assert p.due() is True
+
+
+@pytest.mark.parametrize("poll_s", [0.0, -1.0])
+def test_poller_degenerate_interval_never_stalls(tmp_path, poll_s):
+    """swap_poll_s <= 0 degenerates to 'always due': every tick polls, and
+    neither a frozen nor a backwards clock can wedge the gate."""
+    now = [50.0]
+    p = DeltaPoller(tmp_path, poll_s=poll_s, clock=lambda: now[0])
+    for t in (50.0, 50.0, 10.0, 1e9, -5.0):
+        now[0] = t
+        assert p.due() is True
+
+
 # --------------------------------------------------- durability primitives
 
 
