@@ -538,11 +538,16 @@ def build_sparse_train_bench(batch_size: int, embed_dim: int,
     mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
     specs = ctr_embedding_specs(SIZE_MAP, embed_dim, "row")
     if table_dtype != "float32":
-        # quantized STORAGE (bf16 tables + stochastic-rounding writes);
+        # quantized STORAGE (bf16/int8 tables + stochastic-rounding writes);
         # compute stays f32 either way, so the step program only differs by
-        # the storage width and the SR key threading
+        # the storage width and the SR key threading.  int8 rows carry a
+        # per-row (scale, offset) sidecar and never ride fat lines, so the
+        # int8 arm rebuilds the specs plain
         import dataclasses as _dc
 
+        if table_dtype == "int8":
+            specs = ctr_embedding_specs(SIZE_MAP, embed_dim, "row",
+                                        fused_threshold=None)
         specs = [_dc.replace(s, dtype=jnp.dtype(table_dtype)) for s in specs]
     coll = ShardedEmbeddingCollection(specs, mesh=mesh)
     tables = coll.init(jax.random.key(0))
@@ -1080,6 +1085,96 @@ def bench_serving(batch_size: int = 8192, embed_dim: int = 64,
     return out
 
 
+def bench_retrieval_scale(n_items_list=(1_000_000, 10_000_000),
+                          dim: int = 64, batch: int = 256,
+                          top_k: int = 100) -> dict:
+    """``retrieve_twostage8``: exact f32 scan vs the int8 two-stage program
+    (coarse ``4 * top_k`` over stored codes, exact re-rank of survivors) at
+    corpus scales where the split starts to matter.  Synthetic corpora are
+    drawn ON DEVICE (retrieval cost depends only on geometry, and a 10M x
+    64 f32 host array would crawl through the tunnel); both programs take
+    the corpus as chain ARGUMENTS, timed by the same chain differencing as
+    every other record (CLAUDE.md tunnel rules).  Recall@k of the two-stage
+    answer is measured against the exact scan of the SAME int8 corpus —
+    the exact program is the bitwise-verified reference stand-in
+    (tests/test_serve.py).  Expected-budget fallback when the tunnel is
+    unreachable: docs/BUDGET.md "int8 corpora and two-stage retrieval"."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+    from tdfo_tpu.ops.quant import quantize_rows
+    from tdfo_tpu.serve.corpus import Corpus
+    from tdfo_tpu.serve.retrieval import make_retrieval
+
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    n_shards = mesh.shape["data"]
+    out: dict[str, object] = {"dim": dim, "batch": batch, "top_k": top_k}
+
+    for n_items in n_items_list:
+        n_pad = -(-n_items // n_shards) * n_shards
+        sharding = NamedSharding(mesh, P("data", None))
+        draw = jax.jit(
+            lambda key: jax.random.normal(key, (n_pad, dim), jnp.float32),
+            out_shardings=sharding)
+        vectors = draw(jax.random.key(n_items))
+        codes, qscale = jax.jit(quantize_rows, out_shardings=(
+            sharding, sharding))(vectors)
+        ids = jax.device_put(
+            jnp.where(jnp.arange(n_pad) < n_items,
+                      jnp.arange(n_pad, dtype=jnp.int32), -1),
+            NamedSharding(mesh, P("data")))
+        f32 = Corpus(vectors=vectors, ids=ids, n_items=n_items)
+        i8 = Corpus(vectors=codes, ids=ids, n_items=n_items, qscale=qscale)
+        exact = make_retrieval(f32, mesh=mesh, top_k=top_k)
+        exact8 = make_retrieval(i8, mesh=mesh, top_k=top_k)
+        two = make_retrieval(i8, mesh=mesh, top_k=top_k,
+                             coarse_k=4 * top_k)
+
+        def make_qargs(k, seed):
+            r = np.random.default_rng(seed)
+            q = jax.device_put(
+                r.standard_normal((k, batch, dim)).astype(np.float32))
+            float(jnp.sum(q))
+            return (q,)
+
+        def timed(jitted, operands):
+            def run(k):
+                @jax.jit
+                def chain(qstack, *ops):
+                    def body(carry, q):
+                        s, _ = jitted(q + carry, *ops)
+                        return jnp.abs(s).sum() * jnp.float32(1e-9), None
+
+                    final, _ = jax.lax.scan(body, jnp.float32(0), qstack)
+                    return final
+
+                return lambda qstack: chain(qstack, *operands)
+
+            return chain_time(run, make_qargs, ks=(8, 64), reps=3)
+
+        sec_exact = timed(exact.jitted, (f32.vectors, f32.ids))
+        sec_two = timed(two.jitted, (i8.vectors, i8.qscale, i8.ids))
+
+        r = np.random.default_rng(1)
+        q = jnp.asarray(r.standard_normal((batch, dim)), jnp.float32)
+        _, i_ref = exact8(q)
+        _, i_two = two(q)
+        hits = sum(len(set(a.tolist()) & set(b.tolist()))
+                   for a, b in zip(np.asarray(i_two), np.asarray(i_ref)))
+        out[f"n{n_items // 1_000_000}m"] = {
+            "exact_f32_ms": round(sec_exact * 1e3, 3),
+            "twostage_int8_ms": round(sec_two * 1e3, 3),
+            "speedup": round(sec_exact / sec_two, 2),
+            "recall_at_k": round(hits / np.asarray(i_ref).size, 4),
+            "corpus_bytes_f32": int(f32.vectors.nbytes),
+            "corpus_bytes_int8": int(i8.vectors.nbytes + i8.qscale.nbytes),
+        }
+    return out
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=8192)
@@ -1097,11 +1192,12 @@ def main() -> None:
                          "Criteo-Kaggle tables, 33.76M rows, stacked, "
                          "rowwise-adagrad)")
     ap.add_argument("--table-dtype", default="float32",
-                    choices=["float32", "bfloat16"],
+                    choices=["float32", "bfloat16", "int8"],
                     help="twotower/dlrm sparse headline only: embedding "
-                         "STORAGE dtype (bfloat16 = quantized tables with "
-                         "stochastic-rounding writes; halves table HBM and "
-                         "optimizer row traffic, compute stays f32)")
+                         "STORAGE dtype (bfloat16 halves table HBM; int8 "
+                         "quarters it plus an 8 B/row f32 (scale, offset) "
+                         "sidecar — both keep compute f32 and write with "
+                         "stochastic rounding)")
     ap.add_argument("--skip-big-table", action="store_true")
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving-path records (serve_score8 / "
@@ -1112,6 +1208,10 @@ def main() -> None:
     ap.add_argument("--skip-planner", action="store_true",
                     help="dlrm-criteo only: skip the planner-vs-defaults "
                          "record (planner_dlrm8)")
+    ap.add_argument("--skip-retrieval-scale", action="store_true",
+                    help="skip the 1M/10M-corpus exact-vs-two-stage record "
+                         "(retrieve_twostage8) — the slowest serving record "
+                         "(builds a 10M-row corpus on device)")
     ap.add_argument("--hot-vocab", type=int, default=0,
                     help="dlrm-criteo only: split every table's [0, K) "
                          "frequency-ranked prefix into a replicated hot head "
@@ -1218,6 +1318,14 @@ def main() -> None:
         except Exception as e:  # cache record must never kill the headline
             print(f"bench: cache bench failed: {e!r}", file=sys.stderr)
 
+    retrieval_scale = {}
+    if on_tpu and not args.skip_retrieval_scale and not args.dense:
+        try:
+            retrieval_scale = bench_retrieval_scale()
+        except Exception as e:  # scale record must never kill the headline
+            print(f"bench: retrieval-scale bench failed: {e!r}",
+                  file=sys.stderr)
+
     planner_rec = {}
     if args.model == "dlrm-criteo" and not args.skip_planner:
         # predictions are cheap host math and always emitted; the measured
@@ -1269,15 +1377,17 @@ def main() -> None:
         "big_table_demo": big_table,
         "serving": serving,
         "cache_zipf": cache_zipf,
+        "retrieve_twostage8": retrieval_scale,
         "planner_dlrm8": planner_rec,
         "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,
     }
-    if args.table_dtype == "bfloat16":
+    if args.table_dtype in ("bfloat16", "int8"):
         # the quantized-storage record: same workload as the f32 headline,
-        # half the table HBM — compare step_ms against the f32 run directly
-        record["quant_bf16"] = {
+        # half (bf16) / roughly a quarter (int8 codes + 8 B/row sidecar) the
+        # table HBM — compare step_ms against the f32 run directly
+        record[f"quant_{'bf16' if args.table_dtype == 'bfloat16' else 'int8'}"] = {
             "table_bytes": table_bytes,
             "bytes_per_step": round(floor_bytes, 1),
             "step_ms": round(sec_per_step * 1e3, 3),

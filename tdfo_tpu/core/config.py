@@ -204,6 +204,18 @@ class ServingSpec:
     # flips the degraded flag into its heartbeat — still serving the last
     # good version, but loudly
     max_bad_deltas: int = 3
+    # two-stage retrieval (ScaNN, Guo et al. 2020 — quantized coarse scan
+    # then exact re-rank): candidates kept per query by the coarse stage
+    # before the exact f32 re-rank narrows them to top_k.  0 (default)
+    # keeps the single-stage exact scan — byte-identical serving graphs.
+    # Must be >= top_k when set; values above the corpus size degenerate
+    # statically to the exact scan (bitwise-equal results).
+    coarse_k: int = 0
+    # storage dtype of the coarse-stage corpus scan: "int8" (rowwise
+    # (scale, offset) codes, 4x less corpus HBM than f32), "bfloat16"
+    # (2x), or "float32" (candidate pruning without quantization).  The
+    # re-rank always gathers the exact f32 vectors.
+    coarse_dtype: str = "int8"
     # log full feature payloads (+ labels when present) into the request
     # JSONL so served traffic can replay as an incremental training stream
     # (data/replay.py; Monolith §3.3 online-training joiner analogue).
@@ -579,16 +591,35 @@ class Config:
         if self.sparse_optimizer not in ("adam", "sgd", "adagrad",
                                          "rowwise_adagrad"):
             raise ValueError(f"unknown sparse_optimizer: {self.sparse_optimizer!r}")
-        _storage_dtypes = ("float32", "bfloat16")
+        _storage_dtypes = ("float32", "bfloat16", "int8")
         emb = self.embeddings
         for label, dt in (("table_dtype", emb.table_dtype),
-                          ("slot_dtype", emb.slot_dtype),
                           *((f"table_dtype_overrides[{n!r}]", d)
                             for n, d in emb.table_dtype_overrides)):
             if dt not in _storage_dtypes:
                 raise ValueError(
                     f"embeddings {label} must be one of {_storage_dtypes}, "
                     f"got {dt!r}")
+        if emb.slot_dtype not in ("float32", "bfloat16"):
+            # int8 slots would put second-moment state on a per-row grid the
+            # optimizer math cannot survive (ops/quant.py module docstring)
+            raise ValueError(
+                "embeddings slot_dtype must be one of ('float32', "
+                f"'bfloat16'), got {emb.slot_dtype!r}")
+        _any_int8 = (emb.table_dtype == "int8"
+                     or any(d == "int8" for _, d in emb.table_dtype_overrides))
+        if _any_int8 and emb.cache_rows > 0:
+            raise ValueError(
+                'table_dtype = "int8" does not compose with the update '
+                "cache (cache_rows > 0): the cache mirrors rows at storage "
+                "dtype but flushes by bit copy without the per-row "
+                "(scale, offset) sidecar")
+        if _any_int8 and emb.hot_vocab > 0:
+            raise ValueError(
+                'table_dtype = "int8" does not compose with hot/cold '
+                "storage (hot_vocab > 0): the scatter-free hot-head update "
+                "is a full-block requantize, which re-grids untouched int8 "
+                "rows")
         if (emb.slot_dtype == "bfloat16"
                 and self.sparse_optimizer == "rowwise_adagrad"):
             raise ValueError(
@@ -674,6 +705,20 @@ class Config:
                     "sharded tables there is no exchange to group")
         if self.serving.top_k < 1:
             raise ValueError("serving top_k must be >= 1")
+        if self.serving.coarse_k < 0:
+            raise ValueError(
+                "serving coarse_k must be >= 0 (0 = exact single-stage "
+                "retrieval)")
+        if self.serving.coarse_k and self.serving.coarse_k < self.serving.top_k:
+            raise ValueError(
+                "serving coarse_k must be >= top_k: the coarse stage must "
+                "hand the re-rank at least top_k candidates "
+                f"(coarse_k={self.serving.coarse_k}, "
+                f"top_k={self.serving.top_k})")
+        if self.serving.coarse_dtype not in _storage_dtypes:
+            raise ValueError(
+                f"serving coarse_dtype must be one of {_storage_dtypes}, "
+                f"got {self.serving.coarse_dtype!r}")
         if self.serving.corpus_batch < 1:
             raise ValueError("serving corpus_batch must be >= 1")
         if self.serving.max_batch < 1:

@@ -32,16 +32,28 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "QSCALE_LAYOUT",
     "STORAGE_DTYPES",
     "component_key",
+    "dequantize_rows",
     "quantize",
+    "quantize_rows",
     "sr_key",
     "stochastic_round",
     "table_id",
 ]
 
-# the storage dtypes the [embeddings] table_dtype/slot_dtype knobs accept
-STORAGE_DTYPES = ("float32", "bfloat16")
+# the storage dtypes the [embeddings] table_dtype knob accepts; slot_dtype
+# stays on the first two (int8 slots would quantize second-moment state the
+# optimizer math cannot survive — config.py refuses it)
+STORAGE_DTYPES = ("float32", "bfloat16", "int8")
+
+# Layout stamp for the int8 per-row sidecar: f32 (scale, offset) per row,
+# col 0 = scale, col 1 = offset, the grid of quantize_rows below.  Stamped
+# into checkpoint stamps (train/trainer.py) and corpus manifests
+# (serve/export.py); any future re-grid bumps this string so loaders refuse
+# the mismatch in BOTH directions.
+QSCALE_LAYOUT = "rowwise-f32-scale-offset-v1"
 
 # arbitrary fixed base; all variation comes from the (step, table) folds
 _SR_BASE = 0x5EED
@@ -81,8 +93,78 @@ def quantize(x: jax.Array, dtype, key: jax.Array | None = None) -> jax.Array:
     """Cast ``x`` to the storage ``dtype``: stochastic rounding when
     narrowing with a key, round-to-nearest without one, and a PLAIN astype
     for f32 targets — the default path stays byte-identical to unquantized
-    storage (the astype is an identity op XLA elides)."""
+    storage (the astype is an identity op XLA elides).  int8 storage never
+    routes here — it needs the per-row (scale, offset) sidecar, so int8
+    writers call :func:`quantize_rows` explicitly."""
     dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        raise ValueError(
+            "int8 storage carries a per-row (scale, offset) sidecar — use "
+            "quantize_rows/dequantize_rows, not the scalar quantize path"
+        )
     if dtype == jnp.float32 or key is None:
         return x.astype(dtype)
     return stochastic_round(x, dtype, key)
+
+
+# --------------------------------------------------------------------------
+# int8 rowwise quantization (fbgemm TBE rowwise scale/offset parity)
+# --------------------------------------------------------------------------
+#
+# fbgemm's INT8 SplitTableBatchedEmbedding rows store 8-bit codes plus one
+# (scale, bias) f32 pair per row appended to the line; here the pair lives
+# in a separate f32 [N, 2] sidecar (column 0 = scale, column 1 = offset)
+# because XLA narrow-tiles the int8 data independently of the sidecar.
+#
+# Grid: code q in [-128, 127] decodes as  x = q * scale + offset  with
+#   scale  = (rmax - rmin) / 255
+#   offset = rmin + 128 * scale            (so rmin -> -128, rmax -> 127)
+# A degenerate row (rmax == rmin, including all-zero init rows) stores
+# scale = 1 and codes 0, so constant rows round-trip bit-exactly through
+# offset alone.
+#
+# Unlike bf16, int8 stochastic rounding is NOT identity on stored values:
+# every write recomputes the row's grid from the NEW f32 values, so codes
+# shift even for untouched lanes of a touched row.  Untouched ROWS are
+# never rewritten (the sparse optimizers scatter only gathered rows), which
+# is why int8 is refused on the full-block requantize paths (dense_lazy
+# one-hot tier, fat-line storage, the update cache).
+
+
+def quantize_rows(
+    x: jax.Array, key: jax.Array | None = None
+) -> tuple[jax.Array, jax.Array]:
+    """f32 rows ``[N, D]`` -> (int8 codes ``[N, D]``, f32 ``[N, 2]``
+    (scale, offset) sidecar).  With ``key``: unbiased stochastic rounding
+    on the int8 grid (floor(t + uniform)); without: round-to-nearest.
+    Encoding divides by the STORED f32 scale so decode uses the exact grid
+    the codes were placed on."""
+    x = x.astype(jnp.float32)
+    rmin = jnp.min(x, axis=-1, keepdims=True)
+    rmax = jnp.max(x, axis=-1, keepdims=True)
+    scale = (rmax - rmin) / jnp.float32(255.0)
+    # degenerate rows (constant / zero-init): any nonzero scale works, the
+    # codes come out 0 and offset carries the value exactly
+    scale = jnp.where(scale > 0, scale, jnp.float32(1.0))
+    offset = rmin + jnp.float32(128.0) * scale
+    t = (x - offset) / scale
+    if key is None:
+        q = jnp.round(t)
+    else:
+        q = jnp.floor(t + jax.random.uniform(key, x.shape, jnp.float32))
+    data = jnp.clip(q, -128.0, 127.0).astype(jnp.int8)
+    return data, jnp.concatenate([scale, offset], axis=-1)
+
+
+def dequantize_rows(data: jax.Array, qscale: jax.Array) -> jax.Array:
+    """int8 codes ``[..., D]`` + f32 ``[..., 2]`` sidecar -> f32 rows.
+    Works on jax arrays (traced or not) and on host numpy arrays (the
+    export path dequantizes table views host-side)."""
+    scale = qscale[..., 0:1]
+    offset = qscale[..., 1:2]
+    if isinstance(data, jax.Array) or isinstance(qscale, jax.Array):
+        return data.astype(jnp.float32) * scale + offset
+    import numpy as np
+
+    return (np.asarray(data, np.float32) * np.asarray(scale, np.float32)
+            + np.asarray(offset, np.float32))

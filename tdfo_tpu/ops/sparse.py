@@ -29,7 +29,12 @@ import jax
 import jax.numpy as jnp
 
 from tdfo_tpu.obs import counters
-from tdfo_tpu.ops.quant import component_key, quantize
+from tdfo_tpu.ops.quant import (
+    component_key,
+    dequantize_rows,
+    quantize,
+    quantize_rows,
+)
 
 __all__ = [
     "dedupe_grads",
@@ -183,32 +188,59 @@ def _masked_scatter_rows(table: jax.Array, uids: jax.Array, new_rows: jax.Array,
     return table.at[uids].set(new_rows, mode="drop")
 
 
+def _gather_rows_f32(table, uids, qscale):
+    """Touched-row gather, widened to f32 AFTER the gather.  int8 tables
+    (``qscale`` is the f32 [V, 2] (scale, offset) sidecar) gather the
+    matching sidecar rows and decode through the STORED grid."""
+    if qscale is None:
+        return table[uids].astype(jnp.float32)
+    return dequantize_rows(table[uids], qscale[uids])
+
+
+def _requantize_scatter(table, qscale, uids, new_rows, valid, key):
+    """Write updated f32 rows back at the table's storage dtype.  Plain
+    path: :func:`quantize` + one scatter (returns ``(table, None)``).  int8
+    path: the row grid is recomputed from the NEW values
+    (:func:`quantize_rows` — fbgemm rowwise requantize semantics) and both
+    the codes and the sidecar scatter."""
+    if qscale is None:
+        return _masked_scatter_rows(
+            table, uids, quantize(new_rows, table.dtype, key), valid), None
+    data, qs = quantize_rows(new_rows, key)
+    return (_masked_scatter_rows(table, uids, data, valid),
+            _masked_scatter_rows(qscale, uids, qs, valid))
+
+
 def sparse_sgd(table, uids, g, valid, *, lr: float, weight_decay: float = 0.0,
-               sr_key=None):
+               sr_key=None, qscale=None):
     """fbgemm EXACT_SGD parity: touched rows only, wd applied to touched rows.
 
     Storage dtype discipline (all ``sparse_*``/``dense_lazy_*`` functions):
     gathered rows widen to f32, ALL math runs f32, and only the final write
     requantizes (:func:`tdfo_tpu.ops.quant.quantize` — stochastic rounding
     when ``sr_key`` is given and the table stores narrow; a plain identity
-    astype for f32 tables, keeping the default path byte-identical)."""
-    rows = table[uids].astype(jnp.float32)
+    astype for f32 tables, keeping the default path byte-identical).  int8
+    tables pass their (scale, offset) sidecar as ``qscale`` and get
+    ``(table, qscale)`` back."""
+    rows = _gather_rows_f32(table, uids, qscale)
     g = g.astype(jnp.float32) + weight_decay * rows
-    new_rows = quantize(rows - lr * g, table.dtype, sr_key)
-    return _masked_scatter_rows(table, uids, new_rows, valid)
+    table, qscale = _requantize_scatter(table, qscale, uids, rows - lr * g,
+                                        valid, sr_key)
+    return table if qscale is None else (table, qscale)
 
 
 def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
-                eps=1e-8, weight_decay=0.0, sr_key=None):
+                eps=1e-8, weight_decay=0.0, sr_key=None, qscale=None):
     """Row-sparse AdamW: moments exist per-row; bias correction uses a global
     step count (matches fbgemm ADAM; per-row counts differ negligibly and a
     global count is what optax uses for the dense path).
 
     ``weight_decay`` is decoupled (AdamW) and only touches gathered rows —
     fbgemm semantics, NOT optax's full-table decay.
-    Returns (table, mu, nu, count).
+    Returns (table, mu, nu, count), + qscale when given (int8 tables; the
+    moment slots stay at ``slot_dtype`` — only the table rides int8).
     """
-    rows = table[uids].astype(jnp.float32)
+    rows = _gather_rows_f32(table, uids, qscale)
     mu_r = mu[uids].astype(jnp.float32)
     nu_r = nu[uids].astype(jnp.float32)
     g = g.astype(jnp.float32)
@@ -219,11 +251,10 @@ def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
     mu_hat = mu_n / (1 - b1**t)
     nu_hat = nu_n / (1 - b2**t)
     delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * rows)
-    return (
-        _masked_scatter_rows(
-            table, uids,
-            quantize(rows - delta, table.dtype, component_key(sr_key, 0)),
-            valid),
+    table, qscale = _requantize_scatter(
+        table, qscale, uids, rows - delta, valid, component_key(sr_key, 0))
+    out = (
+        table,
         _masked_scatter_rows(
             mu, uids, quantize(mu_n, mu.dtype, component_key(sr_key, 1)),
             valid),
@@ -232,10 +263,11 @@ def sparse_adam(table, mu, nu, count, uids, g, valid, *, lr, b1=0.9, b2=0.999,
             valid),
         new_count,
     )
+    return out if qscale is None else out + (qscale,)
 
 
 def sparse_rowwise_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
-                           weight_decay=0.0, sr_key=None):
+                           weight_decay=0.0, sr_key=None, qscale=None):
     """fbgemm EXACT_ROWWISE_ADAGRAD parity: ONE f32 accumulator PER ROW
     (mean of squared grads), not per element — optimizer state is V x 4
     bytes instead of V x D x 8, which is what lets a v5e hold a 4x10^8-row
@@ -243,37 +275,34 @@ def sparse_rowwise_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
     for huge tables; ``torchrec/train.py:191`` uses ADAM but fbgemm's TBE
     rowwise variant is the >=1B-row configuration).
     """
-    rows = table[uids].astype(jnp.float32)
+    rows = _gather_rows_f32(table, uids, qscale)
     acc_r = accum[uids]  # [U] — ALWAYS f32 (the fbgemm parity contract)
     g = g.astype(jnp.float32) + weight_decay * rows
     acc_n = acc_r + jnp.mean(g * g, axis=-1)
     delta = lr * g / (jnp.sqrt(acc_n)[:, None] + eps)
-    return (
-        _masked_scatter_rows(
-            table, uids,
-            quantize(rows - delta, table.dtype, component_key(sr_key, 0)),
-            valid),
-        _masked_scatter_rows(accum, uids, acc_n, valid),
-    )
+    table, qscale = _requantize_scatter(
+        table, qscale, uids, rows - delta, valid, component_key(sr_key, 0))
+    out = (table, _masked_scatter_rows(accum, uids, acc_n, valid))
+    return out if qscale is None else out + (qscale,)
 
 
 def sparse_adagrad(table, accum, uids, g, valid, *, lr, eps=1e-10,
-                   weight_decay=0.0, sr_key=None):
+                   weight_decay=0.0, sr_key=None, qscale=None):
     """fbgemm EXACT_ADAGRAD parity (row-wise accumulator of squared grads)."""
-    rows = table[uids].astype(jnp.float32)
+    rows = _gather_rows_f32(table, uids, qscale)
     acc_r = accum[uids].astype(jnp.float32)
     g = g.astype(jnp.float32) + weight_decay * rows
     acc_n = acc_r + g * g
     delta = lr * g / (jnp.sqrt(acc_n) + eps)
-    return (
-        _masked_scatter_rows(
-            table, uids,
-            quantize(rows - delta, table.dtype, component_key(sr_key, 0)),
-            valid),
+    table, qscale = _requantize_scatter(
+        table, qscale, uids, rows - delta, valid, component_key(sr_key, 0))
+    out = (
+        table,
         _masked_scatter_rows(
             accum, uids,
             quantize(acc_n, accum.dtype, component_key(sr_key, 1)), valid),
     )
+    return out if qscale is None else out + (qscale,)
 
 
 def dense_lazy_adam(table, mu, nu, count, ids, grads, *, lr, b1=0.9, b2=0.999,
@@ -953,12 +982,18 @@ class SparseOptimizer:
         )
 
     def update_unique(self, table, slots, uids, g, valid, *,
-                      embedding_dim: int | None = None, sr_key=None):
+                      embedding_dim: int | None = None, sr_key=None,
+                      qscale=None):
         """Tier dispatch on PRE-deduplicated ``(uids, g, valid)`` — the
         dedup-lookup step path (one shared sort per array per step).  The
         small-vocab one-hot tier needs raw ids and is bypassed here;
-        ``sparse_adam`` has identical semantics."""
+        ``sparse_adam`` has identical semantics.  int8 tables pass their
+        (scale, offset) sidecar as ``qscale`` and get ``(table, slots,
+        qscale)`` back (plain 2D storage only — int8 never rides fat
+        lines)."""
         if table.ndim == 3:
+            if qscale is not None:
+                raise ValueError("int8 tables do not ride fat-line storage")
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
             return fat_apply_unique(
@@ -967,29 +1002,45 @@ class SparseOptimizer:
                 eps=self.eps, weight_decay=self.weight_decay, sr_key=sr_key,
             )
         if self.kind == "sgd":
-            return sparse_sgd(table, uids, g, valid, lr=self.lr,
-                              weight_decay=self.weight_decay,
-                              sr_key=sr_key), slots
+            out = sparse_sgd(table, uids, g, valid, lr=self.lr,
+                             weight_decay=self.weight_decay,
+                             sr_key=sr_key, qscale=qscale)
+            if qscale is None:
+                return out, slots
+            table, qscale = out
+            return table, slots, qscale
         if self.kind == "adagrad":
             (accum,) = slots
-            table, accum = sparse_adagrad(
+            out = sparse_adagrad(
                 table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay, sr_key=sr_key)
-            return table, (accum,)
+                weight_decay=self.weight_decay, sr_key=sr_key, qscale=qscale)
+            if qscale is None:
+                table, accum = out
+                return table, (accum,)
+            table, accum, qscale = out
+            return table, (accum,), qscale
         if self.kind == "rowwise_adagrad":
             (accum,) = slots
-            table, accum = sparse_rowwise_adagrad(
+            out = sparse_rowwise_adagrad(
                 table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay, sr_key=sr_key)
-            return table, (accum,)
+                weight_decay=self.weight_decay, sr_key=sr_key, qscale=qscale)
+            if qscale is None:
+                table, accum = out
+                return table, (accum,)
+            table, accum, qscale = out
+            return table, (accum,), qscale
         if self.kind == "adam":
             mu, nu, count = slots
-            table, mu, nu, count = sparse_adam(
+            out = sparse_adam(
                 table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
                 b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
-                sr_key=sr_key,
+                sr_key=sr_key, qscale=qscale,
             )
-            return table, (mu, nu, count)
+            if qscale is None:
+                table, mu, nu, count = out
+                return table, (mu, nu, count)
+            table, mu, nu, count, qscale = out
+            return table, (mu, nu, count), qscale
         raise ValueError(self.kind)
 
     def dense_update(self, table, slots, ids, grads, *, sr_key=None):
@@ -1222,8 +1273,10 @@ class SparseOptimizer:
 
     def update(self, table, slots, ids, grads, *, embedding_dim: int | None = None,
                capacity: int | None = None, max_distinct: int | None = None,
-               sr_key=None):
+               sr_key=None, qscale=None):
         if table.ndim == 3:
+            if qscale is not None:
+                raise ValueError("int8 tables do not ride fat-line storage")
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
             return fat_update(
@@ -1232,7 +1285,12 @@ class SparseOptimizer:
                 eps=self.eps, weight_decay=self.weight_decay,
                 capacity=capacity, max_distinct=max_distinct, sr_key=sr_key,
             )
-        if self.kind == "adam" and table.shape[0] <= self.small_vocab_threshold:
+        if (self.kind == "adam" and qscale is None
+                and table.shape[0] <= self.small_vocab_threshold):
+            # the one-hot tier's full-block requantize would re-grid every
+            # untouched int8 row (quantize_rows is not an identity the way
+            # the bf16 bit trick is), so int8 tables stay on the row
+            # gather/scatter path below whatever their vocab
             mu, nu, count = slots
             table, mu, nu, count = dense_lazy_adam(
                 table, mu, nu, count, ids, grads, lr=self.lr, b1=self.b1,
@@ -1243,32 +1301,9 @@ class SparseOptimizer:
         uids, g, valid = dedupe_grads(ids.reshape(-1), grads.reshape(-1, grads.shape[-1]),
                                       capacity=capacity, vocab=table.shape[0],
                                       max_distinct=max_distinct)
-        if self.kind == "sgd":
-            return sparse_sgd(table, uids, g, valid, lr=self.lr,
-                              weight_decay=self.weight_decay,
-                              sr_key=sr_key), slots
-        if self.kind == "adagrad":
-            (accum,) = slots
-            table, accum = sparse_adagrad(table, accum, uids, g, valid, lr=self.lr,
-                                          eps=self.eps,
-                                          weight_decay=self.weight_decay,
-                                          sr_key=sr_key)
-            return table, (accum,)
-        if self.kind == "rowwise_adagrad":
-            (accum,) = slots
-            table, accum = sparse_rowwise_adagrad(
-                table, accum, uids, g, valid, lr=self.lr, eps=self.eps,
-                weight_decay=self.weight_decay, sr_key=sr_key)
-            return table, (accum,)
-        if self.kind == "adam":
-            mu, nu, count = slots
-            table, mu, nu, count = sparse_adam(
-                table, mu, nu, count, uids, g, valid, lr=self.lr, b1=self.b1,
-                b2=self.b2, eps=self.eps, weight_decay=self.weight_decay,
-                sr_key=sr_key,
-            )
-            return table, (mu, nu, count)
-        raise ValueError(self.kind)
+        return self.update_unique(table, slots, uids, g, valid,
+                                  embedding_dim=embedding_dim, sr_key=sr_key,
+                                  qscale=qscale)
 
 
 def sparse_optimizer(kind: str, lr: float, weight_decay: float = 0.0, **kw) -> SparseOptimizer:

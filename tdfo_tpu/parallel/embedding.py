@@ -59,6 +59,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tdfo_tpu.core.mesh import MODEL_AXIS, shard_map
+from tdfo_tpu.ops.quant import dequantize_rows, quantize_rows
 
 __all__ = ["EmbeddingSpec", "ShardedEmbeddingCollection", "make_embedding_specs"]
 
@@ -167,6 +168,23 @@ def _a2a_bucket_cap(n: int, m: int, cf: float | None) -> int:
 # keeps the train-state STRUCTURE unchanged when the cache is off, so
 # legacy checkpoints restore and the default graphs stay byte-identical.
 CACHE_PREFIX = "__cache__/"
+
+# ``init()`` pytree key prefix of an int8 array's per-row (scale, offset)
+# sidecar (f32 [V, 2]; column 0 = scale, column 1 = offset — the fbgemm
+# rowwise-int8 TBE layout, see ``ops/quant.quantize_rows``).  The sidecar
+# rides the TABLES dict — not slots — because ``init()`` computes it from
+# the freshly drawn f32 rows, while slots are created later from the int8
+# data alone; it shards with its parent array's vocab axis.
+QSCALE_PREFIX = "__qscale__/"
+
+
+def qscale_name(array_name: str) -> str:
+    """Tables-dict key of ``array_name``'s int8 (scale, offset) sidecar."""
+    return QSCALE_PREFIX + array_name
+
+
+def _spec_is_int8(spec: "EmbeddingSpec") -> bool:
+    return jnp.dtype(spec.dtype) == jnp.int8
 
 
 class ShardedEmbeddingCollection:
@@ -294,6 +312,19 @@ class ShardedEmbeddingCollection:
                     f"table {s.name!r}: fused rowwise_adagrad storage "
                     "cannot be bfloat16 (the per-row accumulator is f32 by "
                     "the fbgemm parity contract)")
+            if _spec_is_int8(s) and s.sharding == "column":
+                # the (scale, offset) pair is per FULL row; a column shard
+                # would requantize partial rows against a whole-row grid
+                raise ValueError(
+                    f"table {s.name!r}: int8 storage supports row/"
+                    "replicated/table sharding, not 'column'")
+            if _spec_is_int8(s) and cache_rows > 0:
+                # the cache mirrors rows at storage dtype but flushes by bit
+                # copy WITHOUT the sidecar; config.py refuses the combination
+                # too — this is the construction-time belt-and-braces
+                raise ValueError(
+                    f"table {s.name!r}: int8 storage does not compose with "
+                    "the update cache (cache_rows > 0)")
             for f in s.feature_names():
                 if f in self._feature_to_table:
                     raise ValueError(f"feature {f!r} served by two tables")
@@ -411,6 +442,13 @@ class ShardedEmbeddingCollection:
                     f"table {tname!r}: hot/cold supports plain (non-fused) "
                     f"row/replicated tables; got fused={spec.fused}, "
                     f"sharding={spec.sharding!r}")
+            if _spec_is_int8(spec):
+                # the scatter-free one-hot head update is a full-block
+                # requantize — not an identity on the int8 grid the way the
+                # bf16 bit trick is (ops/quant.py)
+                raise ValueError(
+                    f"table {tname!r}: hot/cold does not compose with int8 "
+                    "storage")
             if tname in self.hot_ids:
                 raise ValueError(f"table {tname!r} given two hot-id sets")
             if self.hot_array_name(tname) in self.specs:
@@ -534,8 +572,13 @@ class ShardedEmbeddingCollection:
                     f"table {name}: embedding_dim {dim} not divisible by "
                     f"{self.n_shards} column shards"
                 )
+            # int8 tables draw at f32 and round-to-nearest onto the rowwise
+            # grid (deterministic, keyless — init has no step to fold), so a
+            # same-seed int8 run starts from the quantization of the exact
+            # f32 tables
+            draw_dtype = jnp.float32 if _spec_is_int8(spec) else spec.dtype
             t = jax.random.uniform(
-                next(key_iter), (rows, dim), spec.dtype,
+                next(key_iter), (rows, dim), draw_dtype,
                 minval=-spec.init_scale, maxval=spec.init_scale,
             )
             if spec.fused:
@@ -544,26 +587,50 @@ class ShardedEmbeddingCollection:
                 # [lines, T, 128]: optimizer state starts at zero
                 t = fat_pack(t, kind=self.fused_kind)
             sh = self.table_sharding(spec)
+            if _spec_is_int8(spec):
+                t, qs = quantize_rows(t)
+                qsh = (None if self.mesh is None else NamedSharding(
+                    self.mesh,
+                    P(self.axis, None) if spec.sharding == "row" else P()))
+                tables[qscale_name(name)] = (
+                    jax.device_put(qs, qsh) if qsh is not None else qs)
             tables[name] = jax.device_put(t, sh) if sh is not None else t
         def assemble_stack(group, key, dtype):
             # each member table keeps its own init scale (slice-wise draws);
             # padding rows stay zero — valid storage, never referenced.
+            # int8 stacks assemble at f32; the caller quantizes the whole
+            # stack (padding rows are constant -> exact through the offset).
+            draw = jnp.float32 if jnp.dtype(dtype) == jnp.int8 else dtype
             total = self._stack_rows[group[0].name][1]
             dim = group[0].embedding_dim
-            t = jnp.zeros((total, dim), dtype)
+            t = jnp.zeros((total, dim), draw)
             for s, k in zip(group, jax.random.split(key, len(group))):
                 off, _ = self._stack_rows[s.name]
                 rows = jax.random.uniform(
-                    k, (s.num_embeddings, dim), dtype,
+                    k, (s.num_embeddings, dim), draw,
                     minval=-s.init_scale, maxval=s.init_scale,
                 )
                 t = jax.lax.dynamic_update_slice(t, rows, (off, 0))
             return t
 
+        def place_stack(gname, arr, group, spec_p):
+            # spec_p None => replicated; quantize int8 stacks AFTER assembly
+            if jnp.dtype(arr.dtype) == jnp.float32 and any(
+                    _spec_is_int8(s) for s in group):
+                arr, qs = quantize_rows(arr)
+                if self.mesh is not None:
+                    qp = P(self.axis, None) if spec_p is not None else P()
+                    qs = jax.device_put(qs, NamedSharding(self.mesh, qp))
+                tables[qscale_name(gname)] = qs
+            if self.mesh is not None:
+                sh = NamedSharding(
+                    self.mesh, spec_p if spec_p is not None else P())
+                arr = jax.device_put(arr, sh)
+            tables[gname] = arr
+
         for gname, group in self._groups.items():
             t = assemble_stack(group, next(key_iter), group[0].dtype)
-            sh = NamedSharding(self.mesh, P(self.axis, None))
-            tables[gname] = jax.device_put(t, sh)
+            place_stack(gname, t, group, P(self.axis, None))
         for gname, (shard_kind, dim, group) in self._fat_groups.items():
             if gname.startswith("__fatstack_"):
                 from tdfo_tpu.ops.pallas_kernels import fat_pack
@@ -572,12 +639,10 @@ class ShardedEmbeddingCollection:
                 arr = fat_pack(t, kind=self.fused_kind)  # [lines, T, 128]
             else:  # plain 2D table stack (stack_tables=True)
                 arr = assemble_stack(group, next(key_iter), group[0].dtype)
-            if self.mesh is not None:
-                trailing = (None,) * (arr.ndim - 1)
-                spec_p = (P(self.axis, *trailing) if shard_kind == "row"
-                          else P())
-                arr = jax.device_put(arr, NamedSharding(self.mesh, spec_p))
-            tables[gname] = arr
+            trailing = (None,) * (arr.ndim - 1)
+            spec_p = (P(self.axis, *trailing) if shard_kind == "row"
+                      else None)
+            place_stack(gname, arr, group, spec_p)
         # hot heads: a GATHER of the already-initialised cold rows (no extra
         # rng keys), so a hot/cold run's initial effective tables are
         # bit-identical to the same-seed non-hot/cold run — the property the
@@ -679,6 +744,11 @@ class ShardedEmbeddingCollection:
             return int(array_name.removeprefix("__stack_"))
         return self.specs[array_name].embedding_dim
 
+    def array_is_int8(self, array_name: str) -> bool:
+        """True when an ``init()`` array stores int8 codes (its f32
+        (scale, offset) sidecar lives at ``qscale_name(array_name)``)."""
+        return jnp.dtype(self._array_rep_spec(array_name).dtype) == jnp.int8
+
     def needs_shard_map_update(self, array_name: str) -> bool:
         """True when the array's sparse update must run inside an explicit
         ``shard_map`` (fused fat storage + real row sharding: Pallas has no
@@ -698,7 +768,8 @@ class ShardedEmbeddingCollection:
                 and self.mesh is not None and self.n_shards > 1)
 
     def sparse_update(self, opt, array_name: str, table, slots, ids, grads,
-                      max_distinct: int | None = None, sr_key=None):
+                      max_distinct: int | None = None, sr_key=None,
+                      qscale=None):
         """Apply the row-sparse optimizer to one table, sharding-aware.
 
         For fused (fat-row) tables ROW-SHARDED over a real model axis the
@@ -722,7 +793,11 @@ class ShardedEmbeddingCollection:
         if not self.needs_shard_map_update(array_name):
             return opt.update(table, slots, ids, grads, embedding_dim=d,
                               capacity=max_distinct, max_distinct=max_distinct,
-                              sr_key=sr_key)
+                              sr_key=sr_key, qscale=qscale)
+        if qscale is not None:  # fused fat storage is f32/bf16-only
+            raise ValueError(
+                f"array {array_name!r}: int8 tables do not ride the fused "
+                "shard_map update path")
 
         from tdfo_tpu.core.mesh import DATA_AXIS
         from tdfo_tpu.ops.sparse import fat_update
@@ -956,6 +1031,11 @@ class ShardedEmbeddingCollection:
                     )
                 else:
                     vecs = jnp.take(table, ids + offset, axis=0)
+                    if _spec_is_int8(spec):
+                        # sidecar rides the same gather; dequantize the SMALL
+                        # gathered block, never the table
+                        vecs = dequantize_rows(vecs, jnp.take(
+                            tables[qscale_name(tname)], ids + offset, axis=0))
                 if self.mesh is not None and spec.sharding == "column":
                     vecs = jax.lax.with_sharding_constraint(
                         vecs, NamedSharding(self.mesh, P(*([None] * ids.ndim), self.axis))
@@ -968,10 +1048,12 @@ class ShardedEmbeddingCollection:
                         f"lookup mode {mode!r} requires row/table sharding, "
                         f"but table {spec.name!r} is {spec.sharding!r}"
                     )
+                qs = (tables[qscale_name(tname)] if _spec_is_int8(spec)
+                      else None)
                 if mode == "psum":
-                    vecs = self._lookup_psum(table, ids + offset, spec)
+                    vecs = self._lookup_psum(table, ids + offset, spec, qs)
                 else:
-                    vecs = self._lookup_alltoall(table, ids + offset, spec)
+                    vecs = self._lookup_alltoall(table, ids + offset, spec, qs)
             else:
                 raise ValueError(f"unknown lookup mode {mode!r}")
             out[feat] = vecs
@@ -1200,30 +1282,54 @@ class ShardedEmbeddingCollection:
         for g in plan:
             recv, slot_inv = ctx[g.key]
             shards = tuple(tables[a] for a in g.arrays)
+            # groups are dtype-uniform ((dim, dtype) keys), so one flag
+            # covers every member array
+            is_int8 = jnp.dtype(g.specs[0].dtype) == jnp.int8
+            qshards = (tuple(tables[qscale_name(a)] for a in g.arrays)
+                       if is_int8 else ())
             gathers = tuple(self._local_gather(s) for s in g.specs)
             local_sizes = tuple(features[f].size // m for f in g.feats)
 
-            def complete(recv_l, slot_inv_l, *shards_l, _g=g,
+            def complete(recv_l, slot_inv_l, *ops, _g=g,
                          _gathers=gathers, _sizes=local_sizes):
+                shards_l = ops[:len(_g.arrays)]
+                qs_l = ops[len(_g.arrays):]
                 flatr = recv_l.reshape(-1)  # [m * cap]
                 valid = flatr >= 0
-                vec = None
+                vec, qvec = None, None
                 # per-array masked gathers; base ranges are disjoint, so the
-                # sum of masked rows IS the select across arrays
-                for shard, gather, rps, base in zip(
-                        shards_l, _gathers, _g.rows_per_shard, _g.bases):
+                # sum of masked rows IS the select across arrays (int8: at
+                # most one term per slot is nonzero, so the int8 adds never
+                # overflow)
+                for ai, (shard, gather, rps, base) in enumerate(zip(
+                        shards_l, _gathers, _g.rows_per_shard, _g.bases)):
                     loc = flatr - base
                     mine = valid & (loc >= 0) & (loc < rps)
-                    rows = gather(shard, jnp.clip(loc, 0, rps - 1))
+                    clipped = jnp.clip(loc, 0, rps - 1)
+                    rows = gather(shard, clipped)
                     rows = jnp.where(mine[:, None], rows, 0)
                     vec = rows if vec is None else vec + rows
+                    if qs_l:
+                        qrows = jnp.where(
+                            mine[:, None],
+                            jnp.take(qs_l[ai], clipped, axis=0), 0)
+                        qvec = qrows if qvec is None else qvec + qrows
                 back = jax.lax.all_to_all(
                     vec.reshape(m, -1, vec.shape[-1]), axis,
                     split_axis=0, concat_axis=0)
-                # dequantize AFTER the exchange: the all_to_all payload rides
-                # at storage dtype (half the bytes for bf16 tables); the
-                # model always sees f32 activations (identity for f32)
-                flat = back.reshape(-1, vec.shape[-1]).astype(jnp.float32)
+                # dequantize AFTER the exchange: the vector all_to_all
+                # payload rides at storage dtype (half the bytes for bf16,
+                # a QUARTER for int8 — the codes ship as int8 and the f32
+                # (scale, offset) rows ride a separate small collective);
+                # the model always sees f32 activations (identity for f32)
+                flat = back.reshape(-1, vec.shape[-1])
+                if qs_l:
+                    qback = jax.lax.all_to_all(
+                        qvec.reshape(m, -1, 2), axis,
+                        split_axis=0, concat_axis=0)
+                    flat = dequantize_rows(flat, qback.reshape(-1, 2))
+                else:
+                    flat = flat.astype(jnp.float32)
                 outv = jnp.where(
                     (slot_inv_l >= 0)[:, None],
                     jnp.take(flat, jnp.maximum(slot_inv_l, 0), axis=0), 0)
@@ -1237,10 +1343,11 @@ class ShardedEmbeddingCollection:
                 complete, mesh=self.mesh,
                 in_specs=(P(axis, None), P(axis),
                           *(P(axis, *([None] * (t.ndim - 1)))
-                            for t in shards)),
+                            for t in shards),
+                          *(P(axis, None) for _ in qshards)),
                 out_specs=tuple(P(axis) for _ in g.feats),
                 check_vma=False,
-            )(recv, slot_inv, *shards)
+            )(recv, slot_inv, *shards, *qshards)
             for f, p in zip(g.feats, parts):
                 out[f] = p.reshape(*features[f].shape, -1)
         return out
@@ -1299,6 +1406,9 @@ class ShardedEmbeddingCollection:
             feat_rps = self._group_feat_rps(g)
             tabs = tuple(tables[a] for a in g.arrays)
             slot_in = tuple(slots[a] for a in g.arrays)
+            is_int8 = jnp.dtype(g.specs[0].dtype) == jnp.int8
+            qs_in = (tuple(tables[qscale_name(a)] for a in g.arrays)
+                     if is_int8 else ())
             n_local = sum(f.shape[0] for f in flats) // m
             cap = _a2a_bucket_cap(n_local, m, cf)
             stream = m * cap
@@ -1310,8 +1420,8 @@ class ShardedEmbeddingCollection:
                 mds.append(min(stream, ceil8(rps // unit + 1)))
             mds = tuple(mds)
 
-            def local_upd(tabs_l, slots_l, *parts, _g=g, _feat_rps=feat_rps,
-                          _mds=mds, _cap=cap):
+            def local_upd(tabs_l, slots_l, qs_tl, *parts, _g=g,
+                          _feat_rps=feat_rps, _mds=mds, _cap=cap):
                 k = len(_g.feats)
                 key_l = parts[2 * k] if len(parts) > 2 * k else None
                 g_parts = parts[k:2 * k]
@@ -1337,10 +1447,10 @@ class ShardedEmbeddingCollection:
                 recv_g = jax.lax.all_to_all(
                     send_g, axis, split_axis=0, concat_axis=0
                 ).reshape(-1, gcat.shape[-1])
-                out_t, out_s = [], []
-                for aname, shard, sl, spec, rps, base, md in zip(
-                        _g.arrays, tabs_l, slots_l, _g.specs,
-                        _g.rows_per_shard, _g.bases, _mds):
+                out_t, out_s, out_q = [], [], []
+                for ai, (aname, shard, sl, spec, rps, base, md) in enumerate(
+                        zip(_g.arrays, tabs_l, slots_l, _g.specs,
+                            _g.rows_per_shard, _g.bases, _mds)):
                     loc = recv_ids - base
                     mine = (recv_ids >= 0) & (loc >= 0) & (loc < rps)
                     mids = jnp.where(mine, loc, -1)
@@ -1360,52 +1470,70 @@ class ShardedEmbeddingCollection:
                         uids, gu, valid = dedupe_grads(
                             mids, mg, capacity=md, vocab=rps,
                             max_distinct=md)
-                        nt, ns = opt.update_unique(
-                            shard, sl, uids, gu, valid, embedding_dim=_g.dim,
-                            sr_key=sk)
+                        if qs_tl:
+                            nt, ns, nq = opt.update_unique(
+                                shard, sl, uids, gu, valid,
+                                embedding_dim=_g.dim, sr_key=sk,
+                                qscale=qs_tl[ai])
+                            out_q.append(nq)
+                        else:
+                            nt, ns = opt.update_unique(
+                                shard, sl, uids, gu, valid,
+                                embedding_dim=_g.dim, sr_key=sk)
                     out_t.append(nt)
                     out_s.append(ns)
-                return tuple(out_t), tuple(out_s)
+                return tuple(out_t), tuple(out_s), tuple(out_q)
 
             tab_specs = tuple(P(axis, *([None] * (t.ndim - 1))) for t in tabs)
             slot_specs = tuple(self._grouped_slot_specs(t, sl)
                                for t, sl in zip(tabs, slot_in))
+            qs_specs = tuple(P(axis, None) for _ in qs_in)
             key_ops = () if sr_key is None else (sr_key,)
-            upd_t, upd_s = shard_map(
+            upd_t, upd_s, upd_q = shard_map(
                 local_upd, mesh=self.mesh,
-                in_specs=(tab_specs, slot_specs,
+                in_specs=(tab_specs, slot_specs, qs_specs,
                           *(P(axis) for _ in flats),
                           *(P(axis, None) for _ in gflats),
                           *(P() for _ in key_ops)),
-                out_specs=(tab_specs, slot_specs),
+                out_specs=(tab_specs, slot_specs, qs_specs),
                 check_vma=False,
-            )(tabs, slot_in, *flats, *gflats, *key_ops)
+            )(tabs, slot_in, qs_in, *flats, *gflats, *key_ops)
             for a, nt, ns in zip(g.arrays, upd_t, upd_s):
                 new_tables[a] = nt
                 new_slots[a] = ns
+            for a, nq in zip(g.arrays, upd_q):
+                # updated sidecars ride new_tables under their prefixed key,
+                # so the train step's dict merge covers them with no extra
+                # call-site plumbing
+                new_tables[qscale_name(a)] = nq
         return new_tables, new_slots
 
     def _lookup_psum(self, table: jax.Array, ids: jax.Array,
-                     spec: EmbeddingSpec) -> jax.Array:
+                     spec: EmbeddingSpec, qscale: jax.Array | None = None
+                     ) -> jax.Array:
         """Explicit row-shard lookup: ids replicated over the model axis.
 
         Each device gathers rows it owns and zeros the rest; one ``psum``
         over the model axis assembles full vectors.  Batch stays sharded
-        over ``data`` untouched.
+        over ``data`` untouched.  int8 tables (``qscale`` given) dequantize
+        at the OWNER before the psum — codes from different rows live on
+        different grids, so summing them across shards would be meaningless.
         """
         mesh = self.mesh
         axis = self.axis
         rows_per_shard = self._rows_per_shard(table, spec)
         gather_rows = self._local_gather(spec)
 
-        def local(table_shard, ids_local):
+        def local(table_shard, ids_local, *qs_shard):
             idx = jax.lax.axis_index(axis)
             start = idx * rows_per_shard
             local_ids = ids_local - start
             mine = (local_ids >= 0) & (local_ids < rows_per_shard)
-            gathered = gather_rows(
-                table_shard, jnp.clip(local_ids, 0, rows_per_shard - 1)
-            )
+            clipped = jnp.clip(local_ids, 0, rows_per_shard - 1)
+            gathered = gather_rows(table_shard, clipped)
+            if qs_shard:
+                gathered = dequantize_rows(
+                    gathered, jnp.take(qs_shard[0], clipped, axis=0))
             gathered = jnp.where(mine[..., None], gathered, 0)
             return jax.lax.psum(gathered, axis)
 
@@ -1414,28 +1542,32 @@ class ShardedEmbeddingCollection:
         ids_spec = P(DATA_AXIS, *([None] * (ids.ndim - 1)))
         out_spec = P(DATA_AXIS, *([None] * ids.ndim))
         table_spec = P(axis, *([None] * (table.ndim - 1)))
+        qs_ops = () if qscale is None else (qscale,)
         return shard_map(
             local,
             mesh=mesh,
-            in_specs=(table_spec, ids_spec),
+            in_specs=(table_spec, ids_spec, *(P(axis, None) for _ in qs_ops)),
             out_specs=out_spec,
             check_vma=False,
-        )(table, ids)
+        )(table, ids, *qs_ops)
 
     def _lookup_alltoall(self, table: jax.Array, ids: jax.Array,
-                         spec: EmbeddingSpec) -> jax.Array:
+                         spec: EmbeddingSpec, qscale: jax.Array | None = None
+                         ) -> jax.Array:
         """torchrec input-dist/output-dist parity: batch AND table sharded
         over the same ``model`` axis.
 
         Per device: bucket local ids by owner shard (capacity = local batch,
         the worst case), ``all_to_all`` id buckets, gather owned rows,
         ``all_to_all`` vectors back, un-permute.  Two collectives per lookup,
-        both riding ICI — the GSPMD-era NCCL a2a plan.
+        both riding ICI — the GSPMD-era NCCL a2a plan.  int8 tables
+        (``qscale`` given) dequantize at the owner; the narrow-wire payload
+        belongs to the grouped program (:meth:`grouped_lookup`).
         """
         if ids.ndim != 1:
             orig_shape = ids.shape
             flat = ids.reshape(-1)
-            out = self._lookup_alltoall(table, flat, spec)
+            out = self._lookup_alltoall(table, flat, spec, qscale)
             return out.reshape(*orig_shape, -1)
 
         mesh = self.mesh
@@ -1445,7 +1577,7 @@ class ShardedEmbeddingCollection:
         gather_rows = self._local_gather(spec)
         cf = self.a2a_capacity_factor
 
-        def local(table_shard, ids_local):
+        def local(table_shard, ids_local, *qs_shard):
             n = ids_local.shape[0]  # local batch
             cap = _a2a_bucket_cap(n, m, cf)
             owner = jnp.clip(ids_local // rows_per_shard, 0, m - 1)  # [n]
@@ -1472,9 +1604,11 @@ class ShardedEmbeddingCollection:
             recv_ids = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0)
             local_idx = recv_ids - jax.lax.axis_index(axis) * rows_per_shard
             valid = recv_ids >= 0
-            gathered = gather_rows(
-                table_shard, jnp.clip(local_idx, 0, rows_per_shard - 1)
-            )
+            clipped = jnp.clip(local_idx, 0, rows_per_shard - 1)
+            gathered = gather_rows(table_shard, clipped)
+            if qs_shard:
+                gathered = dequantize_rows(
+                    gathered, jnp.take(qs_shard[0], clipped, axis=0))
             gathered = jnp.where(valid[..., None], gathered, 0)
             # send vectors back to requesters
             back = jax.lax.all_to_all(gathered, axis, split_axis=0, concat_axis=0)
@@ -1494,10 +1628,11 @@ class ShardedEmbeddingCollection:
             )
 
         table_spec = P(axis, *([None] * (table.ndim - 1)))
+        qs_ops = () if qscale is None else (qscale,)
         return shard_map(
             local,
             mesh=mesh,
-            in_specs=(table_spec, P(axis)),
+            in_specs=(table_spec, P(axis), *(P(axis, None) for _ in qs_ops)),
             out_specs=P(axis),
             check_vma=False,
-        )(table, ids)
+        )(table, ids, *qs_ops)

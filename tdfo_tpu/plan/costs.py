@@ -228,7 +228,7 @@ def dense_step_ms(dense_model: str, batch_size: int) -> float:
 # HBM model (per-device bytes, undivided — the planner applies sharding)
 # --------------------------------------------------------------------------
 
-_DTYPE_BYTES = {"float32": 4, "bfloat16": 2}
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "int8": 1}
 
 
 def padded_lane_width(dim: int) -> int:
@@ -253,9 +253,17 @@ def table_hbm_bytes(
 ) -> int:
     """Allocated bytes of one table + its optimizer state (whole table,
     before any sharding division).  ``hot_k`` adds the replicated dense
-    head (always f32 + dense slot buffers — the head is small)."""
+    head (always f32 + dense slot buffers — the head is small).
+
+    int8 adds the per-row f32 (scale, offset) sidecar (8 B/row) and keeps
+    the slot buffers at ``slot_dtype`` — so at NARROW dims the ratio vs
+    f32 is bounded well under 4x (d=16 sgd: 64 B -> 16 + 8 = 24 B, 2.67x),
+    while lane-padded dims approach it (d=64 sgd: 512 B -> 128 + 8 = 136 B,
+    3.76x; the int8 codes lane-pad 128-wide exactly like f32)."""
     dsize = _DTYPE_BYTES[dtype]
     if fused:
+        if dtype == "int8":
+            raise ValueError("int8 tables do not ride fused fat-line storage")
         width, rows_per_line = line_geometry(dim, optimizer, dtype)
         lane_elems = 128 if dtype == "float32" else 256
         if rows_per_line > 1:
@@ -268,6 +276,8 @@ def table_hbm_bytes(
         body += FULL_SLOT_BUFFERS[optimizer] * vocab * padded * _DTYPE_BYTES[slot_dtype]
         if optimizer == "rowwise_adagrad":
             body += vocab * 4  # the EXACT_ROWWISE_ADAGRAD f32 accumulator
+        if dtype == "int8":
+            body += vocab * 2 * 4  # f32 (scale, offset) per row
     if hot_k > 0:
         k = min(hot_k, vocab)
         head = k * padded_lane_width(dim) * 4 * (1 + FULL_SLOT_BUFFERS[optimizer])

@@ -80,7 +80,7 @@ PLAN_FILENAME = "sharding_plan.json"
 FUSED_MIN_VOCAB = 16384
 
 _SHARDINGS = ("row", "replicated", "table")
-_DTYPES = ("float32", "bfloat16")
+_DTYPES = ("float32", "bfloat16", "int8")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,11 +117,19 @@ def _candidates(name: str, entry: dict, optimizer: str,
                     # dtype; EXACT_ROWWISE_ADAGRAD requires f32 accum
                     # (refused at collection construction, PR 5)
                     continue
+                if fused and dtype == "int8":
+                    # fat lines carry no per-row (scale, offset) sidecar
+                    # (refused at collection construction)
+                    continue
                 for hot_k in hot_ks:
                     if hot_k > 0 and (
                             fused or sharding not in ("row", "replicated")):
                         # hot heads require a plain, row/replicated base
                         # table (parallel/embedding.py hot_ids contract)
+                        continue
+                    if hot_k > 0 and dtype == "int8":
+                        # the hot head's scatter-free update is a full-block
+                        # requantize — illegal on the int8 grid
                         continue
                     out.append(_Candidate(sharding, fused, dtype, hot_k))
     return out
@@ -289,6 +297,9 @@ def plan_tables(
         for n in names
     }
     default_ms = total_ms(defaults)["total_ms"]
+    default_loads, _ = _device_loads(
+        names, stats, defaults, dim=dim, optimizer=optimizer,
+        slot_dtype=slot_dtype, n_devices=n_devices)
 
     tables = {}
     for name in names:
@@ -325,6 +336,7 @@ def plan_tables(
         "predicted_default_ms": round(default_ms, 6),
         "predicted_dense_ms": round(final["dense_ms"], 6),
         "max_device_hbm_bytes": max(loads),
+        "default_max_device_hbm_bytes": max(default_loads),
         "tables": tables,
     }
 
@@ -438,6 +450,13 @@ def format_plan(plan: dict) -> str:
         f"{plan['batch_size']}, {plan['n_devices']} device(s), "
         f"digest {plan_digest(plan)})"
     )
+    if "default_max_device_hbm_bytes" in plan:
+        cur = plan["max_device_hbm_bytes"] / (1 << 20)
+        dflt = plan["default_max_device_hbm_bytes"] / (1 << 20)
+        lines.append(
+            f"per-device HBM: plan {cur:.1f} MB vs all-defaults "
+            f"{dflt:.1f} MB ({dflt - cur:+.1f} MB saved)"
+        )
     return "\n".join(lines)
 
 

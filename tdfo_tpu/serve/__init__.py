@@ -24,12 +24,15 @@ millions of users").  Layering, offline to online:
 from tdfo_tpu.serve.corpus import Corpus, build_corpus, synthetic_item_features
 from tdfo_tpu.serve.export import (
     BUNDLE_VERSION,
+    QSCALE_LAYOUT,
     ServingBundle,
     apply_delta_arrays,
     bundle_digest,
     export_bundle,
+    export_corpus,
     export_delta,
     load_bundle,
+    load_corpus,
     merged_tables,
 )
 from tdfo_tpu.serve.frontend import MicroBatcher, serve_from_config
@@ -51,14 +54,17 @@ __all__ = [
     "DeltaChainError",
     "DeltaPoller",
     "MicroBatcher",
+    "QSCALE_LAYOUT",
     "ServingBundle",
     "SwapController",
     "apply_delta_arrays",
     "build_corpus",
     "bundle_digest",
     "export_bundle",
+    "export_corpus",
     "export_delta",
     "load_bundle",
+    "load_corpus",
     "make_retrieval",
     "make_scorer",
     "merged_tables",

@@ -31,6 +31,7 @@ from tdfo_tpu.models.twotower import (
     TWOTOWER_ITEM_CATEGORICAL,
     _FEATURE_TO_INPUT,
 )
+from tdfo_tpu.ops.quant import STORAGE_DTYPES, quantize_rows
 from tdfo_tpu.serve.scoring import Scorer
 
 __all__ = ["Corpus", "build_corpus", "synthetic_item_features"]
@@ -43,11 +44,15 @@ ITEM_COLUMNS = tuple(_FEATURE_TO_INPUT[f] for f in TWOTOWER_ITEM_CATEGORICAL)
 class Corpus:
     """Sharded candidate corpus: ``vectors[i]`` scores item ``ids[i]``;
     rows with ``ids[i] == -1`` are shard-alignment padding (masked to -inf
-    by retrieval, never returned)."""
+    by retrieval, never returned).  ``qscale`` is the per-row f32
+    ``(scale, offset)`` sidecar of an int8 corpus (``ops/quant.py`` grid):
+    the stored row dequantizes as ``row * scale + offset``; ``None`` for
+    float corpora."""
 
     vectors: jax.Array  # [N_pad, D], sharded P(data, None) under a mesh
     ids: jax.Array  # [N_pad] int32, sharded P(data); -1 = padding
     n_items: int  # real rows (N_pad >= n_items)
+    qscale: jax.Array | None = None  # [N_pad, 2] f32 when vectors are int8
 
 
 def synthetic_item_features(
@@ -76,6 +81,7 @@ def build_corpus(
     corpus_batch: int = 8192,
     mesh=None,
     axis: str = DATA_AXIS,
+    dtype: str = "float32",
 ) -> Corpus:
     """Sweep the item tower over ``item_features`` -> :class:`Corpus`.
 
@@ -84,7 +90,16 @@ def build_corpus(
     ``item_id`` defaults to ``arange(N)``.  Chunks of ``corpus_batch`` rows
     keep the sweep at ONE compiled program; the last chunk zero-pads (valid
     ids, rows sliced off after) rather than compiling a ragged tail shape.
+
+    ``dtype`` picks the storage format: ``"float32"`` (exact), ``"bfloat16"``
+    (half the HBM, score-identical — :func:`mips_scores` casts operands to
+    bf16 anyway), or ``"int8"`` (quarter the HBM plus a [N_pad, 2] f32
+    per-row (scale, offset) sidecar; keyless round-to-nearest on the
+    ``ops/quant.py`` grid, searched by the two-stage coarse scan).
     """
+    if dtype not in STORAGE_DTYPES:
+        raise ValueError(
+            f"corpus dtype {dtype!r} not in {STORAGE_DTYPES}")
     feats = {k: np.asarray(v) for k, v in item_features.items()}
     n_items = len(next(iter(feats.values())))
     feats.setdefault("item_id", np.arange(n_items, dtype=np.int32))
@@ -116,8 +131,16 @@ def build_corpus(
     if n_pad:
         vectors = jnp.pad(vectors, [(0, n_pad), (0, 0)])
         ids = jnp.pad(ids, [(0, n_pad)], constant_values=-1)
+    qscale = None
+    if dtype == "bfloat16":
+        vectors = vectors.astype(jnp.bfloat16)
+    elif dtype == "int8":
+        vectors, qscale = quantize_rows(vectors)
     if mesh is not None:
         vectors = jax.device_put(
             vectors, NamedSharding(mesh, P(axis, None)))
         ids = jax.device_put(ids, NamedSharding(mesh, P(axis)))
-    return Corpus(vectors=vectors, ids=ids, n_items=n_items)
+        if qscale is not None:
+            qscale = jax.device_put(
+                qscale, NamedSharding(mesh, P(axis, None)))
+    return Corpus(vectors=vectors, ids=ids, n_items=n_items, qscale=qscale)

@@ -37,16 +37,24 @@ import jax.numpy as jnp
 import numpy as np
 
 from tdfo_tpu.core.precision import compute_dtype
-from tdfo_tpu.parallel.embedding import CACHE_PREFIX, ShardedEmbeddingCollection
+from tdfo_tpu.ops.quant import QSCALE_LAYOUT, STORAGE_DTYPES, dequantize_rows
+from tdfo_tpu.parallel.embedding import (
+    CACHE_PREFIX,
+    ShardedEmbeddingCollection,
+    qscale_name,
+)
 
 __all__ = [
     "BUNDLE_VERSION",
+    "QSCALE_LAYOUT",
     "ServingBundle",
     "apply_delta_arrays",
     "bundle_digest",
     "export_bundle",
+    "export_corpus",
     "export_delta",
     "load_bundle",
+    "load_corpus",
     "merged_tables",
     "read_raw_bundle",
     "write_raw_bundle",
@@ -108,9 +116,20 @@ def merged_tables(
                     arr[ids[dirty]] = np.asarray(c["rows"])[slot[dirty]]
             views[aname] = arr
         d = spec.embedding_dim
-        rows = np.array(
-            views[aname][off:off + spec.num_embeddings, :d], dtype=np.float32
-        )
+        view = views[aname]
+        if view.dtype == np.int8:
+            # int8 arrays dequantize through their __qscale__/ sidecar —
+            # a raw cast would export codes, not values
+            qs = np.asarray(jax.device_get(tables[qscale_name(aname)]))
+            rows = np.asarray(
+                dequantize_rows(
+                    view[off:off + spec.num_embeddings, :d],
+                    qs[off:off + spec.num_embeddings]),
+                dtype=np.float32)
+        else:
+            rows = np.array(
+                view[off:off + spec.num_embeddings, :d], dtype=np.float32
+            )
         hids = coll.hot_ids.get(tname)
         if hids is not None:
             hot = np.asarray(
@@ -572,3 +591,162 @@ def load_bundle(bundle_dir: str | Path, *, verify: bool = False) -> ServingBundl
         version=int(manifest.get("version", 0)),
         digest=str(manifest.get("digest", "")),
     )
+
+
+# ----------------------------------------------------------- corpus store
+# Retrieval corpora persist like bundles: one npz + a stamped manifest.  A
+# 100M-item int8 corpus is the artifact worth shipping (the f32 one it came
+# from may never have fit a host), so the store keeps the STORED dtype —
+# codes + the per-row (scale, offset) sidecar — and load_corpus re-shards
+# for whatever mesh is serving, which need not match the exporting mesh.
+
+_CORPUS_MANIFEST = "corpus.json"
+_CORPUS_ARRAYS = "corpus.npz"
+
+
+def export_corpus(out_dir: str | Path, corpus, *, step: int = 0) -> Path:
+    """Write a retrieval corpus directory and return its path.
+
+    Stores the UNPADDED rows at their stored dtype (int8 corpora ship codes
+    plus the ``qscale`` sidecar; bf16 ships as uint16 bit patterns, the
+    :func:`_store` idiom).  The manifest stamps ``bundle_version``, the
+    storage ``dtype``, and — for int8 — the ``qscale_layout`` string, so
+    :func:`load_corpus` refuses drift in BOTH directions (a corpus from a
+    future re-grid, or an int8 corpus predating the stamp)."""
+    n = corpus.n_items
+    vectors = np.asarray(jax.device_get(corpus.vectors))[:n]
+    ids = np.asarray(jax.device_get(corpus.ids))[:n]
+    dtype_name = jnp.dtype(vectors.dtype).name
+    if dtype_name not in STORAGE_DTYPES:
+        raise ValueError(
+            f"corpus dtype {dtype_name!r} not in {STORAGE_DTYPES}")
+    arrays: dict[str, np.ndarray] = {
+        "vectors": (vectors.view(np.uint16)
+                    if dtype_name == "bfloat16" else vectors),
+        "ids": np.asarray(ids, np.int32),
+    }
+    manifest: dict[str, Any] = {
+        "bundle_version": BUNDLE_VERSION,
+        "kind": "corpus",
+        "dtype": dtype_name,
+        "n_items": int(n),
+        "dim": int(vectors.shape[1]),
+        "step": int(step),
+    }
+    if dtype_name == "int8":
+        if corpus.qscale is None:
+            raise ValueError(
+                "int8 corpus has no qscale sidecar — it cannot be "
+                "dequantized; refusing to export garbage")
+        arrays["qscale"] = np.asarray(
+            jax.device_get(corpus.qscale), np.float32)[:n]
+        manifest["qscale_layout"] = QSCALE_LAYOUT
+    elif corpus.qscale is not None:
+        raise ValueError(
+            f"{dtype_name} corpus carries a qscale sidecar — only int8 "
+            "rows are scaled; refusing an inconsistent corpus")
+    manifest["digest"] = bundle_digest(manifest, arrays)
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    np.savez(out / _CORPUS_ARRAYS, **arrays)
+    (out / _CORPUS_MANIFEST).write_text(
+        json.dumps(manifest, indent=1, sort_keys=True))
+    return out
+
+
+def load_corpus(corpus_dir: str | Path, *, mesh=None, axis: str = "data"):
+    """Load a stored corpus and re-shard it for ``mesh`` -> ``Corpus``.
+
+    Refusal cases (each a ``ValueError`` naming the cause, the
+    :func:`load_bundle` stance): missing manifest, ``bundle_version``
+    mismatch, unknown dtype, content-digest mismatch, shape drift, an int8
+    corpus whose ``qscale_layout`` is missing (pre-stamp export) or not the
+    one this build reads (future re-grid), a missing sidecar array, and a
+    float corpus that carries one.  Padding re-derives from the TARGET mesh
+    (zero rows, ids -1, int8 padding re-quantized so a same-mesh round trip
+    is bitwise)."""
+    from tdfo_tpu.serve.corpus import Corpus  # circular at module scope
+
+    cdir = Path(corpus_dir)
+    mpath = cdir / _CORPUS_MANIFEST
+    if not mpath.exists():
+        raise ValueError(f"{cdir} is not a corpus store (no {_CORPUS_MANIFEST})")
+    manifest = json.loads(mpath.read_text())
+    found = manifest.get("bundle_version")
+    if found != BUNDLE_VERSION:
+        raise ValueError(
+            f"corpus store {cdir} has bundle_version {found!r}, this build "
+            f"serves {BUNDLE_VERSION} — re-export the corpus.")
+    if manifest.get("kind") != "corpus":
+        raise ValueError(
+            f"{cdir} is a {manifest.get('kind')!r} bundle, not a corpus")
+    dtype_name = manifest["dtype"]
+    if dtype_name not in STORAGE_DTYPES:
+        raise ValueError(
+            f"corpus store {cdir}: unknown dtype {dtype_name!r} (this build "
+            f"reads {STORAGE_DTYPES})")
+    with np.load(cdir / _CORPUS_ARRAYS) as z:
+        arrays = {k: z[k] for k in z.files}
+    got = bundle_digest(manifest, arrays)
+    if got != manifest.get("digest"):
+        raise ValueError(
+            f"corpus store {cdir}: content digest {got} != manifest "
+            f"{manifest.get('digest')!r} — refusing a corrupt corpus")
+
+    n = int(manifest["n_items"])
+    dim = int(manifest["dim"])
+    vectors = arrays["vectors"]
+    if dtype_name == "bfloat16":
+        vectors = vectors.view(jnp.bfloat16)
+    if vectors.shape != (n, dim):
+        raise ValueError(
+            f"corpus store {cdir}: vectors are {vectors.shape}, manifest "
+            f"says {(n, dim)} — refusing a torn corpus")
+    qscale = None
+    if dtype_name == "int8":
+        layout = manifest.get("qscale_layout")
+        if layout != QSCALE_LAYOUT:
+            raise ValueError(
+                f"corpus store {cdir}: int8 qscale_layout {layout!r}, this "
+                f"build reads {QSCALE_LAYOUT!r} — the sidecar grids are not "
+                "value-compatible; re-export the corpus.")
+        if "qscale" not in arrays:
+            raise ValueError(
+                f"corpus store {cdir}: int8 corpus is missing the qscale "
+                "sidecar — refusing a torn corpus")
+        qscale = arrays["qscale"]
+        if qscale.shape != (n, 2):
+            raise ValueError(
+                f"corpus store {cdir}: qscale is {qscale.shape}, expected "
+                f"{(n, 2)} — refusing a torn corpus")
+    elif "qscale" in arrays or "qscale_layout" in manifest:
+        raise ValueError(
+            f"corpus store {cdir}: {dtype_name} corpus carries a qscale "
+            "sidecar — only int8 rows are scaled; refusing an "
+            "inconsistent corpus")
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_shards = mesh.shape[axis] if mesh is not None else 1
+    pad = -(-n // n_shards) * n_shards - n
+    vecs = jnp.asarray(vectors)
+    ids = jnp.asarray(arrays["ids"], jnp.int32)
+    if pad:
+        if qscale is not None:
+            from tdfo_tpu.ops.quant import quantize_rows
+
+            zv, zq = quantize_rows(jnp.zeros((pad, dim), jnp.float32))
+            vecs = jnp.concatenate([vecs, zv])
+            qscale = jnp.concatenate([jnp.asarray(qscale, jnp.float32), zq])
+        else:
+            vecs = jnp.pad(vecs, [(0, pad), (0, 0)])
+        ids = jnp.pad(ids, [(0, pad)], constant_values=-1)
+    if qscale is not None:
+        qscale = jnp.asarray(qscale, jnp.float32)
+    if mesh is not None:
+        vecs = jax.device_put(vecs, NamedSharding(mesh, P(axis, None)))
+        ids = jax.device_put(ids, NamedSharding(mesh, P(axis)))
+        if qscale is not None:
+            qscale = jax.device_put(
+                qscale, NamedSharding(mesh, P(axis, None)))
+    return Corpus(vectors=vecs, ids=ids, n_items=n, qscale=qscale)

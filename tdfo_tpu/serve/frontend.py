@@ -436,13 +436,19 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
 
         n_items = int(config.size_map.get("item", 0))
         if n_items > spec.top_k:
+            # coarse_k > 0 switches on the two-stage program; the corpus
+            # then stores at coarse_dtype (int8 default — the ScaNN-style
+            # memory/scan budget the knob exists for)
             corpus = build_corpus(
                 scorer,
                 synthetic_item_features(config.size_map, n_items,
                                         seed=config.seed),
-                corpus_batch=spec.corpus_batch, mesh=trainer.mesh)
+                corpus_batch=spec.corpus_batch, mesh=trainer.mesh,
+                dtype=spec.coarse_dtype if spec.coarse_k > 0
+                else "float32")
             retrieve = make_retrieval(
-                corpus, mesh=trainer.mesh, top_k=spec.top_k)
+                corpus, mesh=trainer.mesh, top_k=spec.top_k,
+                coarse_k=spec.coarse_k)
             q_batch = {"user_id": np.arange(8, dtype=np.int32) %
                        max(vocab.get("user_id", 1), 1)}
             _, ids = retrieve(scorer.user_embed(q_batch))

@@ -26,18 +26,20 @@ import jax.numpy as jnp
 import optax
 
 from tdfo_tpu.obs import counters as obs_counters
+from tdfo_tpu.ops.quant import dequantize_rows
 from tdfo_tpu.ops.quant import sr_key as _make_sr_key
 from tdfo_tpu.ops.sparse import SparseOptimizer, cache_lookup_rows, dedupe_ids
 from tdfo_tpu.ops.sparse import cache_overlay_rows
-from tdfo_tpu.parallel.embedding import CACHE_PREFIX, ShardedEmbeddingCollection
+from tdfo_tpu.parallel.embedding import (
+    CACHE_PREFIX, ShardedEmbeddingCollection, qscale_name)
 
 
 def _array_is_narrow(state: "SparseTrainState", aname: str) -> bool:
     """True when ``aname``'s table or any optimizer slot is stored narrow
-    (bf16): the signal that its update needs a stochastic-rounding key.
-    Static under jit (dtypes are trace-time constants), so f32 arrays keep
-    a key-free — hence byte-identical — update graph."""
-    if state.tables[aname].dtype == jnp.bfloat16:
+    (bf16 or int8): the signal that its update needs a stochastic-rounding
+    key.  Static under jit (dtypes are trace-time constants), so f32 arrays
+    keep a key-free — hence byte-identical — update graph."""
+    if state.tables[aname].dtype in (jnp.bfloat16, jnp.int8):
         return True
     return any(leaf.dtype == jnp.bfloat16
                for leaf in jax.tree_util.tree_leaves(state.slots[aname]))
@@ -87,12 +89,19 @@ class SparseTrainState:
 
     @classmethod
     def create(cls, *, dense_params, tx, tables, sparse_opt) -> "SparseTrainState":
+        from tdfo_tpu.parallel.embedding import QSCALE_PREFIX
+
         return cls(
             step=jnp.zeros((), jnp.int32),
             dense_params=dense_params,
             opt_state=tx.init(dense_params),
             tables=dict(tables),
-            slots={n: sparse_opt.init(t) for n, t in tables.items()},
+            # int8 (scale, offset) sidecars are storage, not optimized
+            # parameters: they get no slot state (empty tuple keeps the
+            # pytree structure table-keyed and checkpoint-stable)
+            slots={n: (() if n.startswith(QSCALE_PREFIX)
+                       else sparse_opt.init(t))
+                   for n, t in tables.items()},
             tx=tx,
             sparse_opt=sparse_opt,
         )
@@ -386,6 +395,12 @@ def make_sparse_train_step(
                         max_distinct=cap,
                     )
                     rows = jnp.take(table, jnp.where(valid, uids, 0), axis=0)
+                    if coll.array_is_int8(tname):
+                        # sidecar rides the same compact gather; dequantize
+                        # the small block so downstream expand stays f32
+                        rows = dequantize_rows(rows, jnp.take(
+                            state.tables[qscale_name(tname)],
+                            jnp.where(valid, uids, 0), axis=0))
                     if tname in cached:
                         # serve cached (authoritative) rows into the compact
                         # gather — sentinel slots clamp to row 0 exactly like
@@ -519,10 +534,21 @@ def make_sparse_train_step(
                             ))
                     new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
                     continue
-                new_tables[tname], new_slots[tname] = state.sparse_opt.update_unique(
-                    state.tables[tname], state.slots[tname], uids, g_u, valid,
-                    embedding_dim=d_t, sr_key=_sr_key(tname),
-                )
+                if coll.array_is_int8(tname):
+                    qn = qscale_name(tname)
+                    (new_tables[tname], new_slots[tname],
+                     new_tables[qn]) = state.sparse_opt.update_unique(
+                        state.tables[tname], state.slots[tname], uids, g_u,
+                        valid, embedding_dim=d_t, sr_key=_sr_key(tname),
+                        qscale=state.tables[qn],
+                    )
+                else:
+                    new_tables[tname], new_slots[tname] = (
+                        state.sparse_opt.update_unique(
+                            state.tables[tname], state.slots[tname], uids,
+                            g_u, valid, embedding_dim=d_t,
+                            sr_key=_sr_key(tname),
+                        ))
                 continue
             all_ids, _, bound = _concat_ids(feats, cold_ids)
             obs_counters.emit(f"emb/{tname}/touched_ids",
@@ -553,11 +579,21 @@ def make_sparse_train_step(
                 continue
             # sharding-aware routing: fused row-sharded tables update inside
             # an explicit shard_map (Pallas has no GSPMD partition rule)
-            new_tables[tname], new_slots[tname] = coll.sparse_update(
-                state.sparse_opt, tname,
-                state.tables[tname], state.slots[tname], all_ids, all_grads,
-                max_distinct=md, sr_key=_sr_key(tname),
-            )
+            if coll.array_is_int8(tname):
+                qn = qscale_name(tname)
+                (new_tables[tname], new_slots[tname],
+                 new_tables[qn]) = coll.sparse_update(
+                    state.sparse_opt, tname,
+                    state.tables[tname], state.slots[tname], all_ids,
+                    all_grads, max_distinct=md, sr_key=_sr_key(tname),
+                    qscale=state.tables[qn],
+                )
+            else:
+                new_tables[tname], new_slots[tname] = coll.sparse_update(
+                    state.sparse_opt, tname,
+                    state.tables[tname], state.slots[tname], all_ids,
+                    all_grads, max_distinct=md, sr_key=_sr_key(tname),
+                )
 
         # hot-head updates: per logical table, ONE one-hot MXU contraction
         # merges duplicates and a full dense [K, D] read-modify-write
@@ -806,11 +842,21 @@ def make_pipelined_sparse_train_step(
             all_grads = jnp.concatenate([
                 g_embs[f].reshape(-1, g_embs[f].shape[-1]) for f in feats])
             md = -(-bound // 8) * 8 if bound < all_ids.shape[0] else None
-            new_tables[tname], new_slots[tname] = coll.sparse_update(
-                state.sparse_opt, tname,
-                state.tables[tname], state.slots[tname], all_ids, all_grads,
-                max_distinct=md, sr_key=_sr_key(tname),
-            )
+            if coll.array_is_int8(tname):
+                qn = qscale_name(tname)
+                (new_tables[tname], new_slots[tname],
+                 new_tables[qn]) = coll.sparse_update(
+                    state.sparse_opt, tname,
+                    state.tables[tname], state.slots[tname], all_ids,
+                    all_grads, max_distinct=md, sr_key=_sr_key(tname),
+                    qscale=state.tables[qn],
+                )
+            else:
+                new_tables[tname], new_slots[tname] = coll.sparse_update(
+                    state.sparse_opt, tname,
+                    state.tables[tname], state.slots[tname], all_ids,
+                    all_grads, max_distinct=md, sr_key=_sr_key(tname),
+                )
 
         new_state = SparseTrainState(
             step=state.step + 1,

@@ -682,6 +682,13 @@ class Trainer:
                 or cfg.embeddings.slot_dtype != "float32"):
             stamps["table_dtype"] = tstamp
             stamps["slot_dtype"] = cfg.embeddings.slot_dtype
+        if any(v == "int8" for v in tstamp.values()):
+            # int8 state carries extra __qscale__/ arrays in state.tables;
+            # stamp their layout so a restore into a run that would lay the
+            # sidecar out differently (or not at all) refuses loudly
+            from tdfo_tpu.ops.quant import QSCALE_LAYOUT
+
+            stamps["qscale_layout"] = QSCALE_LAYOUT
         if cfg.embeddings.cache_rows > 0:
             # the cache arrays live in state.slots: a cached checkpoint
             # cannot restore into a cache-off run (or vice versa, or across

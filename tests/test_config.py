@@ -244,15 +244,20 @@ def test_serving_table(tmp_path: Path):
     cfg = read_configs()
     assert cfg.serving.top_k == 100
     assert cfg.serving.buckets == (256, 1024, 8192)
+    assert cfg.serving.coarse_k == 0  # exact single-stage by default
+    assert cfg.serving.coarse_dtype == "int8"
     (tmp_path / "config.toml").write_text(
         "[serving]\ntop_k = 10\ncorpus_batch = 512\nmax_batch = 64\n"
-        "batch_deadline_ms = 2.5\nbuckets = [16, 64]\n")
+        "batch_deadline_ms = 2.5\nbuckets = [16, 64]\ncoarse_k = 40\n"
+        'coarse_dtype = "bfloat16"\n')
     cfg = read_configs(tmp_path / "config.toml")
     assert cfg.serving.top_k == 10
     assert cfg.serving.corpus_batch == 512
     assert cfg.serving.max_batch == 64
     assert cfg.serving.batch_deadline_ms == 2.5
     assert cfg.serving.buckets == (16, 64)
+    assert cfg.serving.coarse_k == 40
+    assert cfg.serving.coarse_dtype == "bfloat16"
     (tmp_path / "config.toml").write_text("[serving]\nbogus = 1\n")
     with pytest.raises(ValueError, match="bogus"):
         read_configs(tmp_path / "config.toml")
@@ -271,6 +276,9 @@ def test_serving_knob_validation():
         (dict(buckets=(32, 8)), "strictly increasing"),
         (dict(buckets=(0, 8)), "buckets"),
         (dict(max_batch=64, buckets=(8, 32)), "max_batch"),
+        (dict(coarse_k=-1), "coarse_k"),
+        (dict(coarse_k=50, top_k=100), "coarse_k"),
+        (dict(coarse_dtype="int4"), "coarse_dtype"),
     ):
         with pytest.raises(ValueError, match=match):
             Config(serving=ServingSpec(**bad))
@@ -353,7 +361,10 @@ def test_embeddings_dtype_validation():
         Config(model="dlrm", embeddings=EmbeddingsSpec(slot_dtype="float16"))
     with pytest.raises(ValueError, match="table_dtype_overrides"):
         Config(model="dlrm", embeddings=EmbeddingsSpec(
-            table_dtype_overrides={"user": "int8"}))
+            table_dtype_overrides={"user": "int4"}))
+    # int8 is a TABLE storage dtype only — slots refuse it
+    with pytest.raises(ValueError, match="slot_dtype"):
+        Config(model="dlrm", embeddings=EmbeddingsSpec(slot_dtype="int8"))
     # rowwise_adagrad keeps its f32 per-row accumulator: bf16 slots refused
     with pytest.raises(ValueError, match="rowwise_adagrad"):
         Config(model="dlrm", sparse_optimizer="rowwise_adagrad",

@@ -347,6 +347,24 @@ def test_planner_hbm_budget_demotes_and_refuses(criteo_stats):
         _criteo_plan(criteo_stats, n_devices=8, hbm_gb=0.001)
 
 
+def test_planner_demotes_to_int8_under_tight_budget(criteo_stats):
+    """A budget bf16 cannot satisfy pushes big tables onto int8 storage
+    (the 3.76x d=64 / 2.67x d=16 HBM lever), the summary reports the
+    per-device HBM saved vs all-defaults, and int8 entries never ride the
+    composition paths it refuses (fused, hot/cold)."""
+    plan = _criteo_plan(criteo_stats, n_devices=8, hbm_gb=0.25)
+    int8 = {n: e for n, e in plan["tables"].items()
+            if e["dtype"] == "int8"}
+    assert int8, plan["tables"]
+    assert plan["max_device_hbm_bytes"] <= 0.25 * (1 << 30)
+    assert plan["max_device_hbm_bytes"] \
+        < plan["default_max_device_hbm_bytes"]
+    for e in int8.values():
+        assert not e["fused"] and e["hot_k"] == 0
+    text = format_plan(plan)
+    assert "per-device HBM" in text and "int8" in text
+
+
 def test_load_plan_validation(tmp_path, criteo_stats):
     with pytest.raises(ValueError, match="launch"):
         load_plan(tmp_path / "missing.json")

@@ -407,6 +407,71 @@ def test_no_hand_rolled_retry_sleep_loops():
         + ", ".join(offenders))
 
 
+def test_no_int8_casts_outside_quant_module():
+    """``ops/quant.py`` owns the int8 grid: codes are only meaningful next
+    to their per-row f32 (scale, offset) sidecar, and only
+    ``quantize_rows``/``dequantize_rows`` know the grid (scale =
+    (rmax-rmin)/255, offset = rmin + 128*scale, SR keyed by (step,
+    table_id)).  An ``.astype(jnp.int8)`` / ``.view(jnp.int8)`` /
+    ``bitcast_convert_type(..., jnp.int8)`` anywhere else mints codes with
+    no sidecar (silent garbage on dequant) or re-grids stored codes
+    outside the stamp the checkpoints refuse on — both unrecoverable
+    after the fact.  Casts FROM int8 (``codes.astype(jnp.bfloat16)`` in
+    the coarse scan) stay legal, as does host-side ``np.int8`` (labels,
+    parquet).  Self-tested on a synthetic offender."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    sanctioned = root / "ops" / "quant.py"
+
+    def names_int8(node):
+        # jnp.int8 / jax.numpy.int8, or the "int8" dtype string
+        if isinstance(node, ast.Constant):
+            return node.value == "int8"
+        if not (isinstance(node, ast.Attribute) and node.attr == "int8"):
+            return False
+        base = node.value
+        return (isinstance(base, ast.Name) and base.id == "jnp") or (
+            isinstance(base, ast.Attribute) and base.attr == "numpy"
+            and isinstance(base.value, ast.Name) and base.value.id == "jax")
+
+    def int8_cast_lines(tree):
+        hits = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("astype", "view",
+                                           "bitcast_convert_type")):
+                continue
+            operands = list(node.args) + [k.value for k in node.keywords]
+            if any(names_int8(a) for a in operands):
+                hits.append(node.lineno)
+        return hits
+
+    synthetic = (
+        "import jax.numpy as jnp\n"
+        "def sneak(x):\n"
+        "    return x.astype(jnp.int8)\n")
+    assert int8_cast_lines(ast.parse(synthetic)) == [3]
+
+    offenders, sanctioned_hits = [], 0
+    for path in sorted(root.rglob("*.py")):
+        lines = int8_cast_lines(ast.parse(path.read_text(),
+                                          filename=str(path)))
+        if path == sanctioned:
+            sanctioned_hits += len(lines)
+            continue
+        offenders += [f"{path}:{ln}" for ln in lines]
+    assert sanctioned_hits > 0  # the scanner sees quantize_rows' cast
+    assert not offenders, (
+        "cast to int8 outside ops/quant.py (codes without their (scale, "
+        "offset) sidecar are garbage — route through quantize_rows/"
+        "dequantize_rows): " + ", ".join(offenders))
+
+
 def test_no_adhoc_jsonl_tailers():
     """``data/replay.py`` is the single sanctioned reader of line-oriented
     JSONL streams: it owns torn-tail truncation, seal digest verification,

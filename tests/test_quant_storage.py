@@ -424,3 +424,257 @@ def test_export_upcasts_bf16_exactly(mesh8):
         want = np.asarray(jax.device_get(tables[aname]))[
             off:off + spec.num_embeddings].astype(np.float32)
         np.testing.assert_array_equal(got, want)
+
+
+# ------------------------------------------------------------- int8 rows
+# PR 12: int8 storage rides every bf16 lane plus a per-row f32
+# (scale, offset) sidecar (__qscale__/ arrays in state.tables, the fbgemm
+# rowwise-quantized TBE layout).
+
+
+def test_int8_tables_store_codes_plus_sidecar(mesh8):
+    """int8 tables are [V, D] codes (1 byte/row-element) plus a [V, 2] f32
+    qscale sidecar; reads dequantize AFTER the gather so lookups still ship
+    f32 activations; same-seed init is the RTN quantization of the f32
+    init (max err <= scale/2 per row)."""
+    from tdfo_tpu.ops.quant import dequantize_rows
+    from tdfo_tpu.parallel.embedding import qscale_name
+
+    coll = _qcoll(mesh8, jnp.int8)
+    tables = coll.init(jax.random.PRNGKey(0))
+    f32 = _qcoll(mesh8, jnp.float32).init(jax.random.PRNGKey(0))
+    data_names = [a for a in tables if not a.startswith("__qscale__/")]
+    assert data_names and all(qscale_name(a) in tables for a in data_names)
+    for a in data_names:
+        t, qs = tables[a], tables[qscale_name(a)]
+        assert t.dtype == jnp.int8 and t.nbytes == t.size
+        assert qs.dtype == jnp.float32 and qs.shape == (t.shape[0], 2)
+        err = np.abs(np.asarray(dequantize_rows(t, qs)) - np.asarray(f32[a]))
+        scale = np.asarray(qs)[:, :1]
+        assert (err <= scale / 2 + 1e-7).all(), a
+    embs = jax.jit(lambda t, f: coll.lookup(t, f, mode="alltoall"))(
+        tables, _qfeats(mesh8))
+    assert all(e.dtype == jnp.float32 for e in embs.values())
+
+
+def test_grouped_exchange_carries_int8_payload(mesh8):
+    """Jaxpr pin (acceptance criterion): with table_dtype="int8" +
+    grouped_a2a the VECTOR all_to_all payload is i8 — a quarter of the f32
+    wire bytes — and the (scale, offset) rows ride a separate small f32
+    collective; ids stay int32."""
+    coll = _qcoll(mesh8, jnp.int8)
+    tables = coll.init(jax.random.PRNGKey(0))
+    j = str(jax.make_jaxpr(
+        lambda t, f: coll.lookup(t, f, mode="alltoall"))(
+            tables, _qfeats(mesh8)))
+    a2a_lines = [ln for ln in j.splitlines() if "all_to_all" in ln]
+    assert len(a2a_lines) == 3, j  # ids (i32) + codes (i8) + qscale (f32)
+    assert any("i8[" in ln for ln in a2a_lines), a2a_lines
+    qs_lines = [ln for ln in a2a_lines if "f32[" in ln and "i8[" not in ln]
+    assert len(qs_lines) == 1, a2a_lines  # the sidecar exchange, nothing fat
+    # the sidecar is (scale, offset) pairs: trailing dim 2
+    assert ",2]" in qs_lines[0].split("all_to_all")[0], qs_lines
+
+
+def test_int8_lookup_matches_per_table_modes(mesh8):
+    """Grouped, per-table alltoall, psum, and gspmd lookups agree bitwise
+    on int8 tables: dequantize commutes with every exchange program because
+    each dequantizes at the row's OWNER before mixing rows across tables."""
+    coll_g = _qcoll(mesh8, jnp.int8, grouped=True)
+    coll_p = _qcoll(mesh8, jnp.int8, grouped=False)
+    tables = coll_g.init(jax.random.PRNGKey(0))
+    feats = _qfeats(mesh8)
+    want = jax.jit(lambda t, f: coll_g.lookup(t, f, mode="gspmd"))(
+        tables, feats)
+    for coll, mode in ((coll_g, "alltoall"), (coll_p, "alltoall"),
+                      (coll_p, "psum")):
+        got = jax.jit(lambda t, f, _m=mode, _c=coll: _c.lookup(
+            t, f, mode=_m))(tables, feats)
+        for f in feats:
+            np.testing.assert_array_equal(
+                np.asarray(want[f]).view(np.uint32),
+                np.asarray(got[f]).view(np.uint32),
+                err_msg=f"{mode}:{f}")
+
+
+@pytest.mark.parametrize("kind", ["sgd", "adagrad", "rowwise_adagrad", "adam"])
+def test_int8_sr_training_tracks_f32(mesh8, kind):
+    """Acceptance criterion: int8 rowwise storage with SR requantize reaches
+    held-out AUC within tolerance of f32 on the synthetic CTR task, for all
+    four EmbOptimType kinds."""
+    auc_f32, losses_f32, _ = _run_traj(mesh8, jnp.float32, kind)
+    auc_i8, losses_i8, _ = _run_traj(mesh8, jnp.int8, kind)
+    assert losses_f32[-1] < losses_f32[0], losses_f32
+    assert losses_i8[-1] < losses_i8[0], losses_i8
+    assert auc_f32 > 0.75, (kind, auc_f32)
+    assert abs(auc_f32 - auc_i8) < 0.1, (kind, auc_f32, auc_i8)
+
+
+def test_int8_sr_bit_deterministic_and_resume_identical(mesh8):
+    """Rerun and kill/resume identity for int8: SR keys fold from
+    (state.step, table) only, and the qscale sidecar rides state.tables, so
+    a host round-trip restores codes AND grids bit-exactly."""
+    coll = _qcoll(mesh8, jnp.int8)
+    bs = _traj_batches(4)
+
+    def fresh_state():
+        return SparseTrainState.create(
+            dense_params={"w": jnp.full((D,), 0.3)},
+            tx=optax.adam(1e-2),
+            tables=coll.init(jax.random.PRNGKey(0)),
+            sparse_opt=sparse_optimizer("adam", lr=0.3,
+                                        slot_dtype="bfloat16"),
+        )
+
+    def run(step, state, batches):
+        for b in batches:
+            state, _ = step(state, b)
+        return state
+
+    step1 = make_sparse_train_step(coll, _traj_forward, mode="alltoall",
+                                   donate=False)
+    full_a = run(step1, fresh_state(), bs)
+    full_b = run(step1, fresh_state(), bs)
+    half = run(step1, fresh_state(), bs[:2])
+    half = jax.tree_util.tree_map(lambda x: jnp.asarray(np.asarray(x)), half)
+    step2 = make_sparse_train_step(coll, _traj_forward, mode="alltoall",
+                                   donate=False)
+    resumed = run(step2, half, bs[2:])
+    assert int(resumed.step) == int(full_a.step) == len(bs)
+    for name, want in full_a.tables.items():
+        w = np.asarray(want)
+        np.testing.assert_array_equal(
+            w, np.asarray(full_b.tables[name]),
+            err_msg=f"{name}: rerun not deterministic")
+        np.testing.assert_array_equal(
+            w, np.asarray(resumed.tables[name]),
+            err_msg=f"{name}: resume diverged")
+
+
+def test_f32_default_graph_has_no_int8(mesh8):
+    """Extends the PR 5 key-free pin: the f32 default step jaxpr contains
+    no i8 buffers and no PRNG, while the int8 step contains both — the
+    feature costs nothing unless switched on."""
+    coll = _qcoll(mesh8, jnp.float32)
+    step = make_sparse_train_step(
+        coll, _traj_forward, mode="alltoall", donate=False, jit=False)
+    state = SparseTrainState.create(
+        dense_params={"w": jnp.zeros((D,))},
+        tx=optax.adam(1e-2),
+        tables=coll.init(jax.random.PRNGKey(0)),
+        sparse_opt=sparse_optimizer("adam", lr=0.3),
+    )
+    j = str(jax.make_jaxpr(step)(state, _traj_batches(1)[0]))
+    assert "i8[" not in j
+    assert not any(p in j for p in ("random_bits", "random_fold_in",
+                                    "random_seed"))
+    qc = _qcoll(mesh8, jnp.int8)
+    qstep = make_sparse_train_step(
+        qc, _traj_forward, mode="alltoall", donate=False, jit=False)
+    qstate = SparseTrainState.create(
+        dense_params={"w": jnp.zeros((D,))},
+        tx=optax.adam(1e-2),
+        tables=qc.init(jax.random.PRNGKey(0)),
+        sparse_opt=sparse_optimizer("adam", lr=0.3, slot_dtype="bfloat16"),
+    )
+    qj = str(jax.make_jaxpr(qstep)(qstate, _traj_batches(1)[0]))
+    assert "random_bits" in qj and "i8[" in qj
+
+
+def test_int8_hbm_geometry_criteo_profile():
+    """Acceptance criterion: plan/costs.py geometry shows >= 3.5x table HBM
+    drop vs f32 at the Criteo d=64 profile.  At d=16 the narrow-tile rule
+    (<=16 lanes stay unpadded for BOTH dtypes) caps the win at the honest
+    byte ratio — pinned >= 2.4x so the docstring's ceiling stays true."""
+    from tdfo_tpu.plan.costs import table_hbm_bytes
+
+    V = 33_762_577  # the Criteo-TB vocab the ROADMAP names
+    for dim, floor in ((64, 3.5), (16, 2.4)):
+        f32 = table_hbm_bytes(V, dim, optimizer="sgd", dtype="float32")
+        i8 = table_hbm_bytes(V, dim, optimizer="sgd", dtype="int8")
+        assert f32 / i8 >= floor, (dim, f32 / i8)
+    with pytest.raises(ValueError, match="fused"):
+        table_hbm_bytes(V, 64, optimizer="sgd", dtype="int8", fused=True)
+
+
+def test_int8_stamps_refuse_mismatched_restore(tmp_path):
+    """Both directions (mirrors the PR 5/8 stamp tests): an int8 checkpoint
+    carries table_dtype=int8 + qscale_layout and refuses to restore into an
+    f32 run, a run with no layout stamp, or a run on a DIFFERENT sidecar
+    layout; a stampless f32 checkpoint refuses an int8 run."""
+    from tdfo_tpu.ops.quant import QSCALE_LAYOUT
+    from tdfo_tpu.train.checkpoint import CheckpointManager
+
+    state = {"t": jnp.zeros((4, D), jnp.int8),
+             "__qscale__/t": jnp.zeros((4, 2), jnp.float32)}
+    stamp = {"table_dtype": {"t0": "int8"}, "slot_dtype": "bfloat16",
+             "qscale_layout": QSCALE_LAYOUT}
+    mgr = CheckpointManager(tmp_path / "q")
+    mgr.save(0, state, stamps=stamp)
+    step, restored, _ = mgr.restore(state, stamps=dict(stamp))
+    assert step == 0 and restored["t"].dtype == jnp.int8
+    for bad in (None,                                       # f32-default run
+                {"table_dtype": {"t0": "float32"},          # dtype flipped
+                 "slot_dtype": "bfloat16"},
+                {**stamp, "qscale_layout": "rowwise-f32-scale-offset-v2"},
+                {k: v for k, v in stamp.items()             # layout dropped
+                 if k != "qscale_layout"}):
+        with pytest.raises(ValueError, match="stamps"):
+            mgr.restore(state, stamps=bad)
+    mgr.close()
+    # stampless f32 checkpoint refused by an int8 run (other direction)
+    mgr2 = CheckpointManager(tmp_path / "q2")
+    mgr2.save(0, state)
+    with pytest.raises(ValueError, match="stamps"):
+        mgr2.restore(state, stamps=dict(stamp))
+    mgr2.close()
+
+
+def test_trainer_stamps_qscale_layout(tmp_path):
+    """The trainer's checkpoint stamps carry qscale_layout exactly when an
+    int8 table is configured — f32/bf16 runs keep the stamp absent so their
+    sidecars stay byte-compatible with pre-int8 checkpoints."""
+    from tdfo_tpu.core.config import read_configs
+    from tdfo_tpu.ops.quant import QSCALE_LAYOUT
+    from tdfo_tpu.train.trainer import Trainer
+
+    size_map = {"user": 100, "item": 80, "language": 8, "is_ebook": 2,
+                "format": 8, "publisher": 16, "pub_decade": 16}
+
+    def build(**embeddings):
+        cfg = read_configs(
+            None, model="dlrm", data_dir=str(tmp_path), embed_dim=8,
+            size_map=size_map, stack_tables=False, embeddings=embeddings)
+        return Trainer(cfg, log_dir=tmp_path)
+
+    t = build(table_dtype="int8", slot_dtype="bfloat16")
+    assert t._ckpt_stamps.get("qscale_layout") == QSCALE_LAYOUT
+    assert t.state.tables["user_embed"].dtype == jnp.int8
+    assert "__qscale__/user_embed" in t.state.tables
+    t2 = build()
+    assert "qscale_layout" not in (t2._ckpt_stamps or {})
+
+
+def test_export_dequantizes_int8_exactly(mesh8):
+    """merged_tables inverts int8 storage through the sidecar: the bundle
+    rows are exactly dequantize_rows(codes, qscale) in f32 — never a raw
+    cast of the codes."""
+    from tdfo_tpu.ops.quant import dequantize_rows
+    from tdfo_tpu.parallel.embedding import qscale_name
+    from tdfo_tpu.serve.export import merged_tables
+
+    coll = _qcoll(mesh8, jnp.int8, n_tables=2, grouped=False)
+    tables = coll.init(jax.random.PRNGKey(0))
+    out = merged_tables(coll, tables)
+    for i in range(2):
+        spec = coll.specs[f"t{i}"]
+        got = out[f"t{i}"]
+        assert got.dtype == np.float32
+        assert got.shape == (spec.num_embeddings, D)
+        aname, _, off = coll.resolve_table(f"t{i}")
+        sl = slice(off, off + spec.num_embeddings)
+        want = np.asarray(dequantize_rows(
+            np.asarray(jax.device_get(tables[aname]))[sl],
+            np.asarray(jax.device_get(tables[qscale_name(aname)]))[sl]),
+            dtype=np.float32)
+        np.testing.assert_array_equal(got, want)

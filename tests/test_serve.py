@@ -446,3 +446,244 @@ def test_mips_scores_formula():
     ref = np.asarray(q.astype(jnp.bfloat16), np.float32) @ \
         np.asarray(v.astype(jnp.bfloat16), np.float32).T
     np.testing.assert_allclose(np.asarray(s), ref, rtol=1e-2)
+
+
+# -------------------------------------------- int8 corpora + two-stage
+
+
+def _rand_corpus(mesh, n_items, dim=16, dtype="float32", seed=0):
+    """Manually assembled corpus (no scorer sweep): padded to a shard
+    multiple like ``build_corpus``, ids -1 on padding, quantized AFTER
+    padding — the layout every retrieval program assumes."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tdfo_tpu.ops.quant import quantize_rows
+    from tdfo_tpu.serve.corpus import Corpus
+
+    rng = np.random.default_rng(seed)
+    n_shards = mesh.shape["data"] if mesh is not None else 1
+    pad = (-n_items) % n_shards
+    vecs = np.zeros((n_items + pad, dim), np.float32)
+    vecs[:n_items] = rng.normal(size=(n_items, dim)).astype(np.float32)
+    ids = np.concatenate([np.arange(n_items, dtype=np.int32),
+                          np.full(pad, -1, np.int32)])
+    v, qs = jnp.asarray(vecs), None
+    if dtype == "int8":
+        v, qs = quantize_rows(v)
+    elif dtype == "bfloat16":
+        v = v.astype(jnp.bfloat16)
+    i = jnp.asarray(ids)
+    if mesh is not None:
+        v = jax.device_put(v, NamedSharding(mesh, P("data", None)))
+        i = jax.device_put(i, NamedSharding(mesh, P("data")))
+        if qs is not None:
+            qs = jax.device_put(qs, NamedSharding(mesh, P("data", None)))
+    return Corpus(vectors=v, ids=i, n_items=n_items, qscale=qs)
+
+
+def _recall(ids, ids_ref):
+    a, b = np.asarray(ids), np.asarray(ids_ref)
+    return sum(len(set(r) & set(rr)) for r, rr in zip(a, b)) / b.size
+
+
+def test_int8_corpus_build_and_exact_retrieval(mesh8, tmp_path):
+    """``build_corpus(dtype="int8")`` stores codes + [N_pad, 2] f32 sidecar
+    sharded with the rows, and the EXACT program over it (dequantize
+    in-shard, then the usual scan) is bitwise the reference — which itself
+    scores the corpus as served (dequantized), not pre-quantization."""
+    from jax.sharding import PartitionSpec as P
+
+    coll, _, state = _twotower_sparse(mesh8)
+    scorer = make_scorer(
+        load_bundle(_export_sparse(tmp_path / "b", coll, state)), mesh=mesh8)
+    feats = synthetic_item_features(SIZE_MAP, 333, seed=3)
+    corpus = build_corpus(scorer, feats, corpus_batch=128, mesh=mesh8,
+                          dtype="int8")
+    assert corpus.vectors.dtype == jnp.int8
+    assert corpus.qscale.shape == (336, 2)
+    assert corpus.qscale.dtype == jnp.float32
+    assert corpus.qscale.sharding.spec == P("data", None)
+
+    rng = np.random.default_rng(9)
+    queries = scorer.user_embed(
+        {"user_id": rng.integers(0, SIZE_MAP["user"], 16).astype(np.int32)})
+    s, i = make_retrieval(corpus, mesh=mesh8, top_k=10)(queries)
+    s_ref, i_ref = retrieval_reference(queries, corpus, top_k=10)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+    np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+    assert np.all(np.asarray(i) >= 0)
+
+    # the quantized corpus still serves the same catalog: recall vs the
+    # f32 corpus stays high (rowwise int8 at D=16 is a gentle grid)
+    f32 = build_corpus(scorer, feats, corpus_batch=128, mesh=mesh8)
+    _, i_f32 = retrieval_reference(queries, f32, top_k=10)
+    assert _recall(i_ref, i_f32) >= 0.9
+
+    with pytest.raises(ValueError, match="dtype"):
+        build_corpus(scorer, feats, corpus_batch=128, dtype="int4")
+
+
+def test_twostage_recall_floor_on_zipf_corpus(mesh8):
+    """ISSUE acceptance: two-stage recall@10 >= 0.95 vs the exact
+    reference at ``coarse_k = 4 * top_k`` on a zipf-queried synthetic
+    corpus (popular items queried most, the serving skew)."""
+    corpus = _rand_corpus(mesh8, 1234, dtype="int8", seed=11)
+    rng = np.random.default_rng(12)
+    pop = np.minimum(rng.zipf(1.5, size=32) - 1, 1233)
+    base = np.asarray(jax.device_get(corpus.vectors), np.float32)[pop]
+    queries = jnp.asarray(
+        base + 0.3 * rng.normal(size=base.shape).astype(np.float32))
+    s2, i2 = make_retrieval(
+        corpus, mesh=mesh8, top_k=10, coarse_k=40)(queries)
+    s_ref, i_ref = retrieval_reference(queries, corpus, top_k=10)
+    assert _recall(i2, i_ref) >= 0.95
+    assert np.all(np.asarray(i2) >= 0)
+    del s2, s_ref  # bit-exactness of survivor scores asserted below
+
+
+def test_twostage_rerank_scores_are_exact_bits(mesh8):
+    """Every surviving (query, id) pair's score is bitwise the exact
+    scan's score for that pair — the re-rank stage adds NO approximation
+    on top of storage quantization."""
+    from tdfo_tpu.ops.quant import dequantize_rows
+
+    corpus = _rand_corpus(mesh8, 200, dtype="int8", seed=21)
+    rng = np.random.default_rng(22)
+    queries = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    s2, i2 = make_retrieval(
+        corpus, mesh=mesh8, top_k=10, coarse_k=40)(queries)
+    vecs = dequantize_rows(
+        jnp.asarray(jax.device_get(corpus.vectors))[:200],
+        jnp.asarray(jax.device_get(corpus.qscale))[:200])
+    full = np.asarray(mips_scores(queries, vecs))  # [B, N] exact bits
+    got = np.asarray(s2).view(np.uint32)
+    want = np.take_along_axis(full, np.asarray(i2), axis=1).view(np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_twostage_degenerate_routes_to_exact(mesh8):
+    """``coarse_k >= n_items`` is statically the exact program: bitwise-
+    equal ids AND scores (recall@k == 1.0 by construction)."""
+    corpus = _rand_corpus(mesh8, 120, dtype="int8", seed=31)
+    rng = np.random.default_rng(32)
+    queries = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+    s_exact, i_exact = make_retrieval(corpus, mesh=mesh8, top_k=10)(queries)
+    s_deg, i_deg = make_retrieval(
+        corpus, mesh=mesh8, top_k=10, coarse_k=120)(queries)
+    np.testing.assert_array_equal(np.asarray(i_deg), np.asarray(i_exact))
+    np.testing.assert_array_equal(
+        np.asarray(s_deg).view(np.uint32),
+        np.asarray(s_exact).view(np.uint32))
+    s_ref, i_ref = retrieval_reference(queries, corpus, top_k=10)
+    assert _recall(i_deg, i_ref) == 1.0
+
+
+def test_twostage_tiny_ragged_corpus_clamps_coarse_k(mesh8):
+    """13 items over 4 shards (4 rows/shard after padding): ``coarse_k``
+    clamps to the shard row count, padding ids (-1) never survive the
+    coarse stage, and the output still matches the reference."""
+    corpus = _rand_corpus(mesh8, 13, dtype="int8", seed=41)
+    rng = np.random.default_rng(42)
+    queries = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    retrieve = make_retrieval(corpus, mesh=mesh8, top_k=5, coarse_k=12)
+    s, i = retrieve(queries)
+    ia = np.asarray(i)
+    assert np.all(ia >= 0) and np.all(ia < 13)
+    for row in ia:
+        assert len(set(row.tolist())) == 5  # no duplicate survivors
+    s_ref, i_ref = retrieval_reference(queries, corpus, top_k=5)
+    np.testing.assert_array_equal(ia, np.asarray(i_ref))
+    np.testing.assert_array_equal(
+        np.asarray(s).view(np.uint32), np.asarray(s_ref).view(np.uint32))
+
+
+def test_twostage_single_device_and_float_corpus(mesh8):
+    """The meshless two-stage program and the f32-corpus two-stage program
+    both reduce to the reference answer (coarse == exact scores when
+    nothing is quantized)."""
+    single = _rand_corpus(None, 100, dtype="int8", seed=51)
+    rng = np.random.default_rng(52)
+    queries = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    s, i = make_retrieval(single, top_k=10, coarse_k=40)(queries)
+    s_ref, i_ref = retrieval_reference(queries, single, top_k=10)
+    assert _recall(i, i_ref) >= 0.95
+
+    f32 = _rand_corpus(mesh8, 100, dtype="float32", seed=53)
+    s, i = make_retrieval(f32, mesh=mesh8, top_k=10, coarse_k=100 - 1)(
+        queries)
+    s_ref, i_ref = retrieval_reference(queries, f32, top_k=10)
+    np.testing.assert_array_equal(np.asarray(i), np.asarray(i_ref))
+    np.testing.assert_array_equal(
+        np.asarray(s).view(np.uint32), np.asarray(s_ref).view(np.uint32))
+
+
+def test_twostage_validation(mesh8):
+    corpus = _rand_corpus(mesh8, 50, dtype="int8", seed=61)
+    with pytest.raises(ValueError, match="coarse_k"):
+        make_retrieval(corpus, mesh=mesh8, top_k=10, coarse_k=-1)
+    with pytest.raises(ValueError, match="coarse_k"):
+        make_retrieval(corpus, mesh=mesh8, top_k=10, coarse_k=5)
+
+
+def test_corpus_store_roundtrip_and_refusals(mesh8, tmp_path):
+    """``export_corpus``/``load_corpus``: int8 corpora round-trip bitwise
+    (codes, sidecar, ids) and refuse a future qscale re-grid or a store
+    predating the stamp — the same refuse-on-mismatch discipline as
+    training restores."""
+    import json
+
+    from tdfo_tpu.serve.export import bundle_digest, export_corpus, load_corpus
+
+    corpus = _rand_corpus(mesh8, 333, dtype="int8", seed=71)
+    cdir = tmp_path / "corpus"
+    export_corpus(cdir, corpus, step=7)
+    back = load_corpus(cdir, mesh=mesh8)
+    assert back.vectors.dtype == jnp.int8 and back.n_items == 333
+    np.testing.assert_array_equal(np.asarray(back.vectors),
+                                  np.asarray(corpus.vectors))
+    np.testing.assert_array_equal(
+        np.asarray(back.qscale).view(np.uint32),
+        np.asarray(corpus.qscale).view(np.uint32))
+    np.testing.assert_array_equal(np.asarray(back.ids),
+                                  np.asarray(corpus.ids))
+
+    # a served answer from the reloaded corpus is bitwise the original's
+    rng = np.random.default_rng(72)
+    queries = jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32))
+    s0, i0 = make_retrieval(corpus, mesh=mesh8, top_k=10,
+                            coarse_k=40)(queries)
+    s1, i1 = make_retrieval(back, mesh=mesh8, top_k=10,
+                            coarse_k=40)(queries)
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    np.testing.assert_array_equal(
+        np.asarray(s0).view(np.uint32), np.asarray(s1).view(np.uint32))
+
+    manifest = cdir / "corpus.json"
+    good = json.loads(manifest.read_text())
+    with np.load(cdir / "corpus.npz") as z:
+        arrays = {k: z[k] for k in z.files}
+
+    def _restamp(m):  # a legitimately-stamped store from another build
+        return dict(m, digest=bundle_digest(m, arrays))
+
+    bad = _restamp(dict(good, qscale_layout="rowwise-f32-scale-offset-v2"))
+    manifest.write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="qscale_layout"):
+        load_corpus(cdir, mesh=mesh8)
+    bad = _restamp({k: v for k, v in good.items() if k != "qscale_layout"})
+    manifest.write_text(json.dumps(bad))
+    with pytest.raises(ValueError, match="qscale"):
+        load_corpus(cdir, mesh=mesh8)
+    # a plainly corrupted store (manifest edited, digest stale) also refuses
+    manifest.write_text(json.dumps(dict(good, step=99)))
+    with pytest.raises(ValueError, match="digest"):
+        load_corpus(cdir, mesh=mesh8)
+    manifest.write_text(json.dumps(good))
+
+    # float corpora round-trip too (no sidecar on disk, none tolerated)
+    f32 = _rand_corpus(mesh8, 50, dtype="float32", seed=73)
+    export_corpus(tmp_path / "f32", f32)
+    back32 = load_corpus(tmp_path / "f32", mesh=mesh8)
+    assert back32.qscale is None
+    np.testing.assert_array_equal(np.asarray(back32.vectors),
+                                  np.asarray(f32.vectors))
