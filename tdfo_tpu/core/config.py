@@ -226,6 +226,17 @@ class ServingSpec:
     # Replay tails sealed segments with end-to-end verification; rotation
     # is atomic (seal lands before the successor opens).
     log_segment_bytes: int = 0
+    # frontend replica count (serve/fleet.py): N micro-batching frontends
+    # share one BundleStore and follow its CURRENT/CANARY pointers; each
+    # writes its own request-log directory (replica-<k>) that the online
+    # supervisor folds back into one exactly-once stream.  1 = the
+    # single-frontend layout of PRs 9-10, byte-identical code path.
+    replicas: int = 1
+    # bundle-store retention: keep at most this many newest published
+    # version directories beyond the protected CURRENT/CANARY chain
+    # (serve/swap.py gc_versions, wired through recover() and promotion).
+    # 0 = keep everything (the pre-retention behaviour).
+    keep_versions: int = 0
 
 
 @dataclass(frozen=True)
@@ -320,6 +331,33 @@ class OnlineSpec:
     # exceeded); "skip" drops oldest records down to the bound — counted in
     # replay/skipped — and keeps training on fresh traffic.
     lag_policy: str = "fail"
+    # canary gatekeeper (Monolith §3.3 staged parameter sync): when > 0,
+    # every candidate bundle is shadow-scored before publish, published to
+    # the CANARY pointer (served by canary_fraction of the fleet), watched
+    # for this many heartbeat rounds, then promoted to CURRENT or rolled
+    # back to the last good version bitwise.  0 = the ungated PR-10 path
+    # (publish straight to CURRENT).  Requires [serving] replicas >= 2.
+    canary_cycles: int = 0
+    # fraction of replicas that serve the CANARY pointer during the watch
+    # window (at least one replica; always fewer than the whole fleet, so
+    # a regression reaches at most this slice of traffic).
+    canary_fraction: float = 0.25
+    # maximum tolerated AUC drop: the shadow gate refuses a candidate whose
+    # held-out AUC falls more than this below the serving baseline, and the
+    # canary watch rolls back when canary-replica AUC falls more than this
+    # below the stable replicas.
+    max_auc_regression: float = 0.02
+    # replay batches held out per gated cycle as the shadow-eval slice:
+    # traffic the candidate has NOT trained on (it trains in a later cycle
+    # — progressive validation), scored by candidate + baseline for the
+    # gate and by every replica for canary heartbeats.
+    shadow_eval_batches: int = 1
+    # replay-log retention: keep at most this many fully-consumed sealed
+    # segments behind the committed cursor, deleting older ones (GC refuses
+    # to touch any segment the cursor has not fully passed).  0 = keep
+    # everything.  NOTE: after GC the log only replays from a committed
+    # cursor — replay-from-zero is gone by design.
+    keep_consumed_segments: int = 0
 
 
 @dataclass(frozen=True)
@@ -762,6 +800,14 @@ class Config:
             raise ValueError(
                 "serving log_segment_bytes rotates the replayable request "
                 "log, which only exists with log_features = true")
+        if self.serving.replicas < 1:
+            raise ValueError(
+                "serving replicas must be >= 1 (1 = the single-frontend "
+                "layout)")
+        if self.serving.keep_versions < 0:
+            raise ValueError(
+                "serving keep_versions must be >= 0 (0 = keep every "
+                "published version)")
         if self.telemetry.stall_timeout_s < 0:
             raise ValueError(
                 "telemetry stall_timeout_s must be >= 0 (0 = watchdog off)")
@@ -789,6 +835,37 @@ class Config:
                 "online.request_log requires checkpoint_dir: the replay "
                 "cursor persists as a checkpoint sidecar — without it the "
                 "loop cannot be crash-safe")
+        if self.online.canary_cycles < 0:
+            raise ValueError(
+                "online canary_cycles must be >= 0 (0 = ungated publish)")
+        if self.online.canary_cycles:
+            if self.serving.replicas < 2:
+                raise ValueError(
+                    "online canary_cycles requires serving replicas >= 2: "
+                    "the canary verdict compares canary replicas against "
+                    "stable ones, which a single frontend cannot stage")
+            if self.serving.keep_versions == 1:
+                raise ValueError(
+                    "online canary_cycles requires serving keep_versions "
+                    "of 0 (unbounded) or >= 2: the watch window needs the "
+                    "last good version AND the canary candidate on disk")
+        if not (0.0 < self.online.canary_fraction < 1.0):
+            raise ValueError(
+                "online canary_fraction must be in (0, 1): at least one "
+                "canary replica, never the whole fleet "
+                f"(got {self.online.canary_fraction})")
+        if self.online.max_auc_regression < 0:
+            raise ValueError(
+                "online max_auc_regression must be >= 0 (the tolerated "
+                "held-out/canary AUC drop)")
+        if self.online.shadow_eval_batches < 1:
+            raise ValueError(
+                "online shadow_eval_batches must be >= 1: the gate needs "
+                "at least one held-out batch to score")
+        if self.online.keep_consumed_segments < 0:
+            raise ValueError(
+                "online keep_consumed_segments must be >= 0 (0 = keep "
+                "every sealed segment)")
         if self.planner.hbm_gb < 0:
             raise ValueError(
                 "planner hbm_gb must be >= 0 (0 = unlimited device memory)")

@@ -32,6 +32,21 @@ process recounts nothing: ``records`` (trained), ``bad`` (quarantined),
 travel with the byte position.  ``counters()`` surfaces them — plus the
 measured records-behind ``replay/lag`` — through the PR-7 telemetry path.
 
+A serving FLEET writes one log per replica (``<root>/replica-<k>``);
+``MergedReplayConsumer`` folds those into one exactly-once stream keyed by
+``(replica_id, seq)`` — each replica's writer owns its own seq line, so the
+existing per-log dedup applies per replica and the merger round-robins
+whole records across replicas deterministically.  Its cursor nests one
+plain cursor per replica plus the round-robin position, and commits
+all-or-nothing like the single-log one.  ``make_replay_consumer`` picks
+the right reader from the directory layout.
+
+Retention: ``gc_consumed_segments`` deletes sealed segments the committed
+cursor has fully passed (keeping the newest ``keep``); the guarded
+``gc_segments`` refuses to touch any segment the cursor still points into.
+After a GC the log only replays from a committed cursor — replay-from-zero
+is gone by design.
+
 Reading JSONL line-by-line outside this module is rejected by
 ``tests/test_quality.py``: ad-hoc tailers would bypass the truncation and
 digest checks that make replay exactly-once.
@@ -51,10 +66,13 @@ from tdfo_tpu.utils import faults as _faults
 
 __all__ = [
     "REPLAY_SCHEMA_VERSION",
+    "MergedReplayConsumer",
     "ReplayError",
     "ReplayLagError",
     "RequestLog",
     "ReplayConsumer",
+    "make_replay_consumer",
+    "replica_log_dir",
 ]
 
 REPLAY_SCHEMA_VERSION = 1
@@ -83,6 +101,21 @@ def _list_segments(root: Path) -> list[int]:
     for p in root.glob("requests-*.jsonl"):
         stem = p.name[len("requests-"):-len(".jsonl")]
         if stem.isdigit():
+            out.append(int(stem))
+    return sorted(out)
+
+
+def replica_log_dir(root: str | Path, replica_id: int) -> Path:
+    """Per-replica request-log directory under a fleet log root — the
+    naming contract the fleet writer and the merged reader share."""
+    return Path(root) / f"replica-{replica_id}"
+
+
+def _list_replicas(root: Path) -> list[int]:
+    out = []
+    for p in root.glob("replica-*"):
+        stem = p.name[len("replica-"):]
+        if p.is_dir() and stem.isdigit():
             out.append(int(stem))
     return sorted(out)
 
@@ -471,18 +504,16 @@ class ReplayConsumer:
         self._cursor = cur
         return self.lag()
 
-    def next_batch(self):
-        """Assemble one deterministic batch of exactly ``batch_size`` rows.
-
-        Returns ``(batch, consumed)`` — ``batch`` maps schema columns to
-        ``[batch_size]`` arrays; ``consumed`` lists ``(seq, row_start,
-        row_end)`` spans for record-id accounting — or ``None`` when fewer
-        than ``batch_size`` rows are durably available (partial progress is
-        discarded; the cursor only ever commits whole batches)."""
-        cur = dict(self._cursor)
-        taken: dict[str, list] = {col: [] for col in self.schema}
-        consumed: list[tuple[int, int, int]] = []
-        need = self.batch_size
+    def _take(self, cur: dict[str, int], taken: dict[str, list],
+              consumed: list[tuple[int, int, int]], need: int, *,
+              max_records: int | None = None) -> int:
+        """Advance the WORKING cursor ``cur`` over the log, appending up to
+        ``need`` rows of trainable columns into ``taken`` and their
+        ``(seq, row_start, row_end)`` spans into ``consumed``.  Stops after
+        ``max_records`` whole train records (the merged consumer's record-
+        level round-robin grain), when ``need`` is filled, or when durable
+        data runs out.  Returns the rows taken; commits nothing."""
+        got, records = 0, 0
         for line, seg, next_offset in self._lines(cur):
             prev_seq = cur["last_seq"]  # restored on a mid-record boundary
             kind, info, cols = self._classify(line, cur)
@@ -505,22 +536,39 @@ class ReplayConsumer:
                 raise ReplayError(
                     f"cursor row {start} >= record rows {rows} at seq "
                     f"{rec['seq']} — cursor does not match this log")
-            stop = min(rows, start + need)
+            stop = min(rows, start + need - got)
             for col, arr in cols.items():
                 taken[col].append(arr[start:stop])
             consumed.append((rec["seq"], start, stop))
-            need -= stop - start
+            got += stop - start
             if stop == rows:
                 cur["records"] += 1
                 cur["segment"], cur["offset"], cur["row"] = seg, next_offset, 0
+                records += 1
             else:
                 # mid-record batch boundary: stay ON this line, resume at row
                 # `stop`; un-bump the dedup seq so the re-read is not a dup
                 cur["row"] = stop
                 cur["last_seq"] = prev_seq
-            if need == 0:
+            if got >= need:
                 break
-        if need > 0:
+            if max_records is not None and records >= max_records:
+                break
+        return got
+
+    def next_batch(self):
+        """Assemble one deterministic batch of exactly ``batch_size`` rows.
+
+        Returns ``(batch, consumed)`` — ``batch`` maps schema columns to
+        ``[batch_size]`` arrays; ``consumed`` lists ``(seq, row_start,
+        row_end)`` spans for record-id accounting — or ``None`` when fewer
+        than ``batch_size`` rows are durably available (partial progress is
+        discarded; the cursor only ever commits whole batches)."""
+        cur = dict(self._cursor)
+        taken: dict[str, list] = {col: [] for col in self.schema}
+        consumed: list[tuple[int, int, int]] = []
+        got = self._take(cur, taken, consumed, self.batch_size)
+        if got < self.batch_size:
             return None  # not enough durable rows: all-or-nothing, no commit
         batch = {col: np.concatenate(parts) for col, parts in taken.items()}
         self._cursor = cur
@@ -528,3 +576,215 @@ class ReplayConsumer:
         if inj is not None:
             inj.maybe_kill_replay(cur["records"])
         return batch, consumed
+
+    def peek_batches(self, n: int) -> list[dict[str, np.ndarray]]:
+        """Read up to ``n`` batches PAST the committed position without
+        moving the cursor — the gated supervisor's shadow-eval slice:
+        traffic the cycle's candidate has NOT trained on (it trains in a
+        later cycle — progressive validation), so gate scores are always
+        held-out.  Returns fewer than ``n`` batches when the log drains."""
+        saved = dict(self._cursor)
+        out = []
+        try:
+            for _ in range(int(n)):
+                got = self.next_batch()
+                if got is None:
+                    break
+                out.append(got[0])
+        finally:
+            self._cursor = saved
+        return out
+
+    # -------------------------------------------------------------- retention
+
+    def gc_segments(self, upto: int) -> list[int]:
+        """Delete sealed segments ``0..upto`` (data + seal sidecar).
+        REFUSES — ``ValueError``, nothing deleted — when the committed
+        cursor still points into any candidate segment, or when a
+        candidate below the cursor is unsealed (chain damage a GC must not
+        paper over).  Returns the deleted segment indices."""
+        upto = int(upto)
+        if upto < 0:
+            return []
+        if upto >= self._cursor["segment"]:
+            raise ValueError(
+                f"refusing to GC segment {upto}: the committed replay "
+                f"cursor still points into segment "
+                f"{self._cursor['segment']} — only segments the cursor has "
+                "fully passed may be deleted")
+        doomed = [i for i in _list_segments(self.root) if i <= upto]
+        for i in doomed:
+            if self._seal(i) is None:
+                raise ValueError(
+                    f"refusing to GC segment {i}: no seal sidecar below the "
+                    "committed cursor — the rotation order guarantees seals "
+                    "land first, so this chain is damaged, not consumable")
+        removed = []
+        for i in doomed:
+            (self.root / _seg_name(i)).unlink()
+            (self.root / _seal_name(i)).unlink()
+            self._verified.discard(i)
+            removed.append(i)
+        return removed
+
+    def gc_consumed_segments(self, keep: int = 0) -> list[int]:
+        """Retention sweep ([online] keep_consumed_segments): delete fully-
+        consumed sealed segments, keeping the newest ``keep`` of them
+        behind the committed cursor.  Returns the deleted indices."""
+        upto = self._cursor["segment"] - 1 - max(0, int(keep))
+        if upto < 0:
+            return []
+        return self.gc_segments(upto)
+
+
+class MergedReplayConsumer:
+    """Exactly-once batch former over a FLEET of per-replica request logs.
+
+    A multi-replica serving fleet (``serve/fleet.py``) writes one
+    ``RequestLog`` per replica under ``<root>/replica-<k>``; this consumer
+    folds them into a single deterministic stream.  Identity is
+    ``(replica_id, seq)`` — each sub-log keeps its own dedup ``last_seq``,
+    so a seq collision ACROSS replicas is two distinct records, while a
+    crash-redo WITHIN one replica's log still dedups.  Interleave order is
+    record-level round-robin over replica ids ascending, starting from the
+    persisted ``rr`` index; a replica with no durable record simply yields
+    its turn.  The merged cursor ``{"rr": int, "replicas": {str(id):
+    sub_cursor}}`` commits all-or-nothing alongside the cycle checkpoint,
+    same single-durability-point discipline as the flat consumer.
+    """
+
+    def __init__(self, root: str | Path, *, schema: dict[str, tuple],
+                 batch_size: int, max_bad_records: int = 0,
+                 max_lag_records: int = 0, lag_policy: str = "fail",
+                 cursor: dict | None = None):
+        self.root = Path(root)
+        self.batch_size = int(batch_size)
+        ids = _list_replicas(self.root)
+        if not ids:
+            raise ValueError(
+                f"no replica-<k> request-log directories under {self.root} — "
+                f"a merged replay consumer needs a fleet log layout")
+        subs: dict | None = None
+        self._rr = 0
+        if cursor is not None:
+            unknown = set(cursor) - {"rr", "replicas"}
+            if unknown or "replicas" not in cursor:
+                raise ValueError(
+                    f"cursor is not a merged replay cursor (keys "
+                    f"{sorted(cursor)}) — a fleet log cannot resume from a "
+                    f"single-log cursor")
+            self._rr = int(cursor.get("rr", 0))
+            subs = cursor["replicas"]
+            ghost = set(subs) - {str(i) for i in ids}
+            if ghost:
+                raise ValueError(
+                    f"merged replay cursor names replicas {sorted(ghost)} "
+                    f"with no log directory under {self.root} — cursor does "
+                    f"not match this fleet")
+        self._ids = ids
+        self._subs = {
+            i: ReplayConsumer(
+                replica_log_dir(self.root, i), schema=schema,
+                batch_size=batch_size, max_bad_records=max_bad_records,
+                max_lag_records=max_lag_records, lag_policy=lag_policy,
+                cursor=None if subs is None else subs.get(str(i)))
+            for i in ids
+        }
+        self.schema = dict(schema)
+
+    def next_batch(self):
+        """One deterministic ``batch_size``-row batch round-robined across
+        replica logs, or ``None`` when the fleet has too few durable rows.
+        ``consumed`` spans are 4-tuples ``(replica_id, seq, row_start,
+        row_end)``.  All sub-cursors commit together or not at all."""
+        curs = {i: dict(s._cursor) for i, s in self._subs.items()}
+        taken: dict[str, list] = {col: [] for col in self.schema}
+        consumed: list[tuple[int, int, int, int]] = []
+        need = self.batch_size
+        got_total = 0
+        rr, dry = self._rr, 0
+        ids = self._ids
+        while got_total < need and dry < len(ids):
+            rid = ids[rr % len(ids)]
+            sub = self._subs[rid]
+            spans: list[tuple[int, int, int]] = []
+            got = sub._take(curs[rid], taken, spans, need - got_total,
+                            max_records=1)
+            consumed.extend((rid, s, a, b) for s, a, b in spans)
+            got_total += got
+            if got == 0:
+                dry += 1
+                rr += 1
+            else:
+                dry = 0
+                # a mid-record split keeps the turn so the record finishes
+                # contiguously next batch; a whole record passes the turn
+                if curs[rid]["row"] == 0:
+                    rr += 1
+        if got_total < need:
+            return None  # all-or-nothing: no sub-cursor moved
+        batch = {col: np.concatenate(parts) for col, parts in taken.items()}
+        for i, s in self._subs.items():
+            s._cursor = curs[i]
+        self._rr = rr % len(ids)
+        inj = _faults.active()
+        if inj is not None:
+            inj.maybe_kill_replay(
+                sum(s._cursor["records"] for s in self._subs.values()))
+        return batch, consumed
+
+    def peek_batches(self, n: int) -> list[dict[str, np.ndarray]]:
+        """Shadow-eval slice (see ``ReplayConsumer.peek_batches``): up to
+        ``n`` batches past the committed position, nothing committed."""
+        saved = {i: dict(s._cursor) for i, s in self._subs.items()}
+        saved_rr = self._rr
+        out = []
+        try:
+            for _ in range(int(n)):
+                got = self.next_batch()
+                if got is None:
+                    break
+                out.append(got[0])
+        finally:
+            for i, s in self._subs.items():
+                s._cursor = saved[i]
+            self._rr = saved_rr
+        return out
+
+    def cursor(self) -> dict:
+        """The committed merged cursor (deep copy — persist as-is)."""
+        return {"rr": self._rr,
+                "replicas": {str(i): s.cursor()
+                             for i, s in self._subs.items()}}
+
+    def lag(self) -> int:
+        return sum(s.lag() for s in self._subs.values())
+
+    def counters(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for s in self._subs.values():
+            for k, v in s.counters().items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def check_backpressure(self) -> int:
+        return sum(s.check_backpressure() for s in self._subs.values())
+
+    def gc_consumed_segments(self, keep: int = 0) -> list[tuple[int, int]]:
+        """Retention sweep over every replica log.  Returns deleted
+        segments as ``(replica_id, segment)`` pairs."""
+        out = []
+        for i, s in self._subs.items():
+            out.extend((i, seg) for seg in s.gc_consumed_segments(keep))
+        return out
+
+
+def make_replay_consumer(root: str | Path, **kw):
+    """The one construction point callers should use: a
+    ``MergedReplayConsumer`` when ``root`` holds a fleet layout
+    (``replica-<k>`` subdirectories), a flat ``ReplayConsumer`` otherwise.
+    Keyword arguments pass through unchanged."""
+    root = Path(root)
+    if _list_replicas(root):
+        return MergedReplayConsumer(root, **kw)
+    return ReplayConsumer(root, **kw)

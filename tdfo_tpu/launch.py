@@ -10,11 +10,15 @@ Subcommands:
   * ``train`` (default)      — build the Trainer from config and fit.
   * ``serve``                — export the newest checkpoint to a serving
     bundle and run the micro-batching scoring frontend (+ a retrieval round
-    for TwoTower); knobs live in the ``[serving]`` config table.
+    for TwoTower); ``[serving] replicas > 1`` runs a multi-replica fleet
+    over one bundle store with per-replica request logs
+    (``tdfo_tpu/serve/fleet.py``); knobs live in the ``[serving]`` table.
   * ``online``               — close the loop: replay the frontend's request
     log (``[serving] log_features``) into incremental training cycles, each
     ending in a delta export + hot swap (``tdfo_tpu/train/online.py``);
-    knobs live in the ``[online]`` config table.
+    with ``[online] canary_cycles > 0`` every candidate is shadow-scored on
+    held-out replayed traffic, canaried on a fraction of the serving fleet
+    and auto-rolled-back on AUC regression; knobs live in ``[online]``.
   * ``plan``                 — price every per-table embedding placement
     against the measured cost model (``tdfo_tpu/plan``) using the
     preprocessing ``table_stats.json`` and write ``sharding_plan.json``;

@@ -17,8 +17,12 @@ millions of users").  Layering, offline to online:
     loop with per-request latency JSONL, bounded-queue load shedding, and
     drain-and-flip hot swap; ``launch.py serve`` entry point.
   * :mod:`~tdfo_tpu.serve.swap`      — delta-chain bundle store: digest-
-    verified ingest/apply, atomic publication + CURRENT pointer, crash
-    recovery, corrupt-delta quarantine and degraded mode.
+    verified ingest/apply, atomic publication + CURRENT/CANARY pointers,
+    crash recovery, corrupt-delta quarantine, rejection ledger, retention
+    GC and degraded mode.
+  * :mod:`~tdfo_tpu.serve.fleet`     — multi-replica frontends following the
+    shared store pointers (canary cohort + per-replica request logs +
+    held-out heartbeats), the serving tier the gated online loop watches.
 """
 
 from tdfo_tpu.serve.corpus import Corpus, build_corpus, synthetic_item_features
@@ -35,6 +39,7 @@ from tdfo_tpu.serve.export import (
     load_corpus,
     merged_tables,
 )
+from tdfo_tpu.serve.fleet import ReplicaFrontend, ServingFleet
 from tdfo_tpu.serve.frontend import MicroBatcher, serve_from_config
 from tdfo_tpu.serve.retrieval import make_retrieval, mips_scores, retrieval_reference
 from tdfo_tpu.serve.scoring import make_scorer
@@ -55,7 +60,9 @@ __all__ = [
     "DeltaPoller",
     "MicroBatcher",
     "QSCALE_LAYOUT",
+    "ReplicaFrontend",
     "ServingBundle",
+    "ServingFleet",
     "SwapController",
     "apply_delta_arrays",
     "build_corpus",

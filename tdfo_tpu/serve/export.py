@@ -50,6 +50,7 @@ __all__ = [
     "ServingBundle",
     "apply_delta_arrays",
     "bundle_digest",
+    "bundle_from_raw",
     "export_bundle",
     "export_corpus",
     "export_delta",
@@ -528,22 +529,34 @@ def load_bundle(bundle_dir: str | Path, *, verify: bool = False) -> ServingBundl
     if not mpath.exists():
         raise ValueError(f"{bdir} is not a serving bundle (no {_MANIFEST})")
     manifest = json.loads(mpath.read_text())
+    with np.load(bdir / _ARRAYS) as z:
+        raw = {k: z[k] for k in z.files}
+    return bundle_from_raw(manifest, raw, source=str(bdir), verify=verify)
+
+
+def bundle_from_raw(manifest: Mapping[str, Any],
+                    raw_arrays: Mapping[str, np.ndarray], *,
+                    source: str = "<memory>",
+                    verify: bool = False) -> ServingBundle:
+    """Validate ``(manifest, STORED arrays)`` into a :class:`ServingBundle`
+    without touching disk — the same refusal cases as :func:`load_bundle`,
+    which delegates here.  The gated supervisor uses this to score a
+    candidate composition (``BundleStore.compose_delta``) on the shadow
+    slice BEFORE any pointer names it; ``source`` labels the refusals."""
     found = manifest.get("bundle_version")
     if found != BUNDLE_VERSION:
         raise ValueError(
-            f"serving bundle {bdir} has bundle_version {found!r}, this build "
+            f"serving bundle {source} has bundle_version {found!r}, this build "
             f"serves {BUNDLE_VERSION}.  The array schemas are not "
             "value-compatible across versions; re-export the checkpoint.")
     dtype_name = manifest["dtype"]
-    with np.load(bdir / _ARRAYS) as z:
-        raw = {k: z[k] for k in z.files}
     if verify:
-        got = bundle_digest(manifest, raw)
+        got = bundle_digest(manifest, raw_arrays)
         if got != manifest.get("digest"):
             raise ValueError(
-                f"serving bundle {bdir}: content digest {got} != manifest "
+                f"serving bundle {source}: content digest {got} != manifest "
                 f"{manifest.get('digest')!r} — refusing a corrupt bundle")
-    arrays = {k: _load_stored(v, dtype_name) for k, v in raw.items()}
+    arrays = {k: _load_stored(v, dtype_name) for k, v in raw_arrays.items()}
 
     kind = manifest["kind"]
     tables = dense_params = params = None
@@ -552,14 +565,14 @@ def load_bundle(bundle_dir: str | Path, *, verify: bool = False) -> ServingBundl
         stored = {k.removeprefix("table:") for k in arrays if k.startswith("table:")}
         if stored != set(schema):
             raise ValueError(
-                f"serving bundle {bdir}: manifest tables {sorted(schema)} != "
+                f"serving bundle {source}: manifest tables {sorted(schema)} != "
                 f"stored arrays {sorted(stored)} — refusing a torn bundle")
         tables = {}
         for n, (rows, dim) in schema.items():
             t = arrays[f"table:{n}"]
             if t.shape != (rows, dim):
                 raise ValueError(
-                    f"serving bundle {bdir}: table {n!r} is {t.shape}, "
+                    f"serving bundle {source}: table {n!r} is {t.shape}, "
                     f"manifest says {(rows, dim)} — refusing a torn bundle")
             tables[n] = t
         dense_params = _unflatten({
@@ -572,9 +585,9 @@ def load_bundle(bundle_dir: str | Path, *, verify: bool = False) -> ServingBundl
             for k, v in arrays.items() if k.startswith("params:")
         })
         if not params:
-            raise ValueError(f"serving bundle {bdir}: dense bundle holds no params")
+            raise ValueError(f"serving bundle {source}: dense bundle holds no params")
     else:
-        raise ValueError(f"serving bundle {bdir}: unknown kind {kind!r}")
+        raise ValueError(f"serving bundle {source}: unknown kind {kind!r}")
 
     return ServingBundle(
         kind=kind,

@@ -1,0 +1,292 @@
+"""Multi-replica serving frontends over one shared :class:`BundleStore`.
+
+A production serving tier is N identical frontends behind a load balancer
+(Monolith §3.3 runs its parameter-synchronised serving replicas this way;
+torchrec's inference path reloads a ``DistributedModelParallel`` module
+per-host from one published snapshot).  This module is that tier scaled
+down to one process: each :class:`ReplicaFrontend` owns its own
+:class:`~tdfo_tpu.serve.frontend.MicroBatcher` and its own request-log
+directory (``<root>/replica-<k>`` — the layout
+``data/replay.MergedReplayConsumer`` folds back into one stream), while
+ALL replicas follow the store's shared ``CURRENT``/``CANARY`` pointers.
+
+Replicas are pointer FOLLOWERS, not per-replica store-mutating
+``SwapController``s: the delta chain admits each version exactly once (a
+second ``apply_delta`` of the same delta raises ``DeltaChainError``), so
+exactly one writer — the online supervisor — mutates the store and every
+replica merely re-reads the pointers on :meth:`ServingFleet.sync`.  A
+canary MEMBER follows ``CANARY`` when one is pending; everyone else stays
+on ``CURRENT``.  Because rollback deletes the canary dir and pointer and
+promotion moves ``CURRENT`` itself, the same sync walk converges every
+replica bitwise onto whatever the store says is good — there is no
+per-replica state to reconcile.
+
+Deterministic faults (``utils/faults.py``): ``regress_auc_at_cycle``
+models training/serving skew by replacing a named version's logits with a
+feature heuristic (no model call — the bundle itself is healthy, which is
+exactly why only the canary watch, not the shadow gate, can catch it);
+``kill_replica_nth`` drops one replica dead at its first canary watch
+round, in-process, so restart lineages see identical membership.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from tdfo_tpu.serve.export import load_bundle
+from tdfo_tpu.serve.frontend import MicroBatcher
+from tdfo_tpu.serve.scoring import make_scorer
+from tdfo_tpu.serve.swap import BundleStore, _version_name
+from tdfo_tpu.train.metrics import binary_auc
+from tdfo_tpu.utils import faults as _faults
+
+__all__ = ["ReplicaFrontend", "ServingFleet"]
+
+
+class ReplicaFrontend:
+    """One serving replica: a micro-batcher plus the pointer-follow logic.
+
+    ``sync`` is the whole replica lifecycle: read the pointer this replica
+    follows (``CANARY`` for canary members while one is pending, else
+    ``CURRENT``), and when the ``(version, digest)`` pair changed, load
+    the bundle (digest-verified), build a fresh scorer, and hot-swap the
+    batcher onto it.  ``skew_digests`` injects the training/serving-skew
+    fault: for bundles with those digests the scorer is replaced by a
+    feature heuristic (negated first continuous column), so the replica
+    serves confidently wrong logits from a bundle whose bytes are perfect.
+    """
+
+    def __init__(self, replica_id: int, store: BundleStore, serving_spec,
+                 *, mesh=None, logger=None, request_log_root=None,
+                 canary_member: bool = False):
+        self.replica_id = int(replica_id)
+        self.store = store
+        self.spec = serving_spec
+        self.mesh = mesh
+        self.canary_member = bool(canary_member)
+        self._logger = logger
+        self.batcher: MicroBatcher | None = None
+        # (version, digest, skewed): skew membership is part of the served
+        # identity — a restart lineage may sync onto a pending canary
+        # BEFORE the supervisor re-arms the skew fault, and the later sync
+        # must then reload the same bytes with the skewed scorer or the
+        # two lineages diverge.
+        self._served: tuple[int, str, bool] | None = None
+        self._score_fn: Callable | None = None
+        self._request_log = None
+        if request_log_root is not None:
+            from tdfo_tpu.data.replay import RequestLog, replica_log_dir
+
+            self._request_log = RequestLog(
+                replica_log_dir(request_log_root, self.replica_id),
+                segment_bytes=serving_spec.log_segment_bytes)
+
+    # ------------------------------------------------------------- follow
+
+    def _target_pointer(self) -> dict | None:
+        if self.canary_member:
+            can = self.store._read_pointer("CANARY")
+            cur = self.store.current_version()
+            if can is not None and (cur is None or can["version"] > cur):
+                return can
+        return self.store._read_pointer("CURRENT")
+
+    def sync(self, skew_digests: frozenset[str] = frozenset()) -> int | None:
+        """Follow this replica's pointer; reload iff (version, digest,
+        skewed) changed.  Returns the version now being served (None =
+        empty store, nothing to serve yet)."""
+        ptr = self._target_pointer()
+        if ptr is None:
+            return None
+        skewed = str(ptr["digest"]) in skew_digests
+        key = (int(ptr["version"]), str(ptr["digest"]), skewed)
+        if key == self._served:
+            return key[0]
+        version = key[0]
+        bdir = self.store.versions / _version_name(version)
+        bundle = load_bundle(bdir, verify=True)
+        scorer = make_scorer(bundle, mesh=self.mesh)
+        cache_probe: Callable[[], int] | None = scorer.score_cache_size
+        if skewed:
+            # training/serving skew stand-in: healthy bytes, wrong logits.
+            # No model call — deterministic, and independent of how well
+            # the real model fits.
+            cont_col = scorer.cont_columns[0]
+
+            def score_fn(batch, _col=cont_col):
+                return -np.asarray(batch[_col], np.float32)
+
+            cache_probe = None  # nothing jitted behind the heuristic
+        else:
+            score_fn = scorer.score
+        self._score_fn = score_fn
+        if self.batcher is None:
+            self.batcher = MicroBatcher(
+                score_fn, buckets=self.spec.buckets,
+                max_batch=self.spec.max_batch,
+                batch_deadline_ms=self.spec.batch_deadline_ms,
+                logger=self._logger, program_cache_size=cache_probe,
+                max_queue=self.spec.max_queue,
+                shed_policy=self.spec.shed_policy,
+                request_log=self._request_log)
+            self.batcher._version = version
+        else:
+            self.batcher.swap(score_fn, version=version,
+                              program_cache_size=cache_probe)
+        self._served = key
+        return version
+
+    # -------------------------------------------------------------- serve
+
+    def score_direct(self, feats: dict[str, np.ndarray]) -> np.ndarray:
+        """Score one batch on the replica's CURRENT scorer, bypassing the
+        micro-batcher — the heartbeat path, which must not append to the
+        request log (scoring our own replayed traffic back into the log
+        would feed the gate its own output).  The jitted scorer donates
+        its input, so callers pass a fresh dict of fresh arrays."""
+        if self._score_fn is None:
+            raise RuntimeError(
+                f"replica {self.replica_id} has never synced — no scorer")
+        return np.asarray(
+            self._score_fn({k: np.asarray(v) for k, v in feats.items()}))
+
+    def version(self) -> int | None:
+        return None if self._served is None else self._served[0]
+
+    def close(self) -> None:
+        if self._request_log is not None:
+            self._request_log.close()
+            self._request_log = None
+
+
+class ServingFleet:
+    """N replicas following one store, plus the canary-watch instrumentation.
+
+    The first ``max(1, int(n * canary_fraction))`` replica ids are the
+    canary cohort — a fixed, deterministic membership, same on every
+    restart lineage.  ``heartbeat`` is the per-replica health sample the
+    gatekeeper consumes: held-out AUC plus a wall-clock latency figure per
+    alive replica, tagged with cohort membership.  Dead replicas
+    (``kill_replica_nth``) stop syncing, serving and heartbeating but are
+    NOT forgotten: their request logs remain merged-replay inputs, so
+    exactly-once accounting survives replica death.
+    """
+
+    def __init__(self, store: BundleStore, config, *, mesh=None,
+                 logger=None, request_log_root=None):
+        n = int(config.serving.replicas)
+        if n < 1:
+            raise ValueError(f"serving.replicas must be >= 1, got {n}")
+        frac = float(config.online.canary_fraction)
+        self.n_canary = max(1, int(n * frac)) if n > 1 else 0
+        self.replicas = [
+            ReplicaFrontend(
+                k, store, config.serving, mesh=mesh, logger=logger,
+                request_log_root=request_log_root,
+                canary_member=k < self.n_canary)
+            for k in range(n)
+        ]
+        self.store = store
+        self._dead: set[int] = set()
+        self._skew_digests: set[str] = set()
+        self._logger = logger
+
+    # ------------------------------------------------------------ members
+
+    def alive(self) -> list[ReplicaFrontend]:
+        return [r for r in self.replicas if r.replica_id not in self._dead]
+
+    def alive_canaries(self) -> list[ReplicaFrontend]:
+        return [r for r in self.alive() if r.canary_member]
+
+    def mark_canary_watch(self) -> None:
+        """Consult the ``kill_replica_nth`` fault at a canary watch round:
+        replica ``nth - 1`` drops dead (in-process — its scorer and
+        batcher stop participating; its request log stays on disk for the
+        merged replay)."""
+        inj = _faults.active()
+        if inj is not None and inj.replica_kill_due():
+            victim = int(inj.spec.kill_replica_nth) - 1
+            if 0 <= victim < len(self.replicas):
+                self._dead.add(victim)
+                if self._logger is not None:
+                    self._logger.log(event="replica_dead", replica=victim,
+                                     reason="kill_replica_nth")
+
+    def set_score_skew(self, digest: str) -> None:
+        """Arm the training/serving-skew fault for the bundle with this
+        digest: any replica that syncs onto it serves heuristic logits.
+        Keyed by DIGEST, not version — rollback deletes the bad candidate
+        and the next cycle REUSES its version number for different
+        bytes, which must serve honestly."""
+        self._skew_digests.add(str(digest))
+
+    # -------------------------------------------------------------- sync
+
+    def sync(self) -> dict[int, int | None]:
+        """Point every alive replica at its pointer; returns the served
+        version per replica id."""
+        skew = frozenset(self._skew_digests)
+        return {r.replica_id: r.sync(skew) for r in self.alive()}
+
+    def versions(self) -> dict[int, int | None]:
+        return {r.replica_id: r.version() for r in self.alive()}
+
+    # ---------------------------------------------------------- heartbeat
+
+    def heartbeat(self, feats: dict[str, np.ndarray],
+                  labels: np.ndarray) -> list[dict[str, Any]]:
+        """One health sample per alive replica on a held-out slice:
+        ``{replica, version, auc, ms, canary}``.  Fresh arrays per call —
+        the scorer donates its inputs."""
+        out = []
+        for r in self.alive():
+            t0 = time.monotonic()
+            scores = r.score_direct(
+                {k: np.array(v) for k, v in feats.items()})
+            ms = (time.monotonic() - t0) * 1000.0
+            out.append({
+                "replica": r.replica_id, "version": r.version(),
+                "auc": binary_auc(labels, scores), "ms": ms,
+                "canary": r.canary_member,
+            })
+        return out
+
+    # -------------------------------------------------------------- serve
+
+    def probe_each(self, requests) -> dict[int, dict[Any, np.ndarray]]:
+        """Run the same request trace through EVERY alive replica's
+        micro-batcher — the bitwise fleet-convergence probe.  Each replica
+        gets its own copy of the trace (scorers donate; batchers log)."""
+        out = {}
+        for r in self.alive():
+            if r.batcher is None:
+                continue
+            trace = [(rid, {k: np.array(v) for k, v in batch.items()})
+                     for rid, batch in requests]
+            out[r.replica_id] = dict(r.batcher.run(trace))
+        return out
+
+    def run(self, requests) -> dict[Any, np.ndarray]:
+        """Round-robin a request trace across alive replicas — the load-
+        balancer path the fleet quickstart demonstrates."""
+        alive = [r for r in self.alive() if r.batcher is not None]
+        if not alive:
+            raise RuntimeError("no alive, synced replica to serve on")
+        results: dict[Any, np.ndarray] = {}
+        for i, (rid, batch) in enumerate(requests):
+            r = alive[i % len(alive)]
+            r.batcher.submit(rid, batch)
+            r.batcher.poll()
+        for r in alive:
+            r.batcher.drain()
+            results.update(r.batcher.results)
+        return results
+
+    def close(self) -> None:
+        for r in self.replicas:
+            r.close()

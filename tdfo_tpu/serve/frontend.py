@@ -377,13 +377,14 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
     vocab = _column_vocab(config, cat_cols)
     rng = np.random.default_rng(config.seed)
     spec = config.serving
+    base = Path(log_dir or config.checkpoint_dir or ".")
+    fleet_mode = spec.replicas > 1
     request_log = None
-    if spec.log_features:
+    if spec.log_features and not fleet_mode:
         from tdfo_tpu.data.replay import RequestLog
 
-        request_log = RequestLog(
-            Path(log_dir or config.checkpoint_dir or ".") / "request_log",
-            segment_bytes=spec.log_segment_bytes)
+        request_log = RequestLog(base / "request_log",
+                                 segment_bytes=spec.log_segment_bytes)
     # labels come from a SEPARATE rng so turning log_features on never
     # perturbs the request trace itself (the feedback join is out-of-band)
     label_rng = np.random.default_rng(config.seed + 1)
@@ -397,36 +398,70 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
         }
         for c in cont_cols:
             batch[c] = rng.random(n, dtype=np.float32)
-        if request_log is not None:
+        if spec.log_features:
             batch["label"] = label_rng.integers(0, 2, size=n, dtype=np.int8)
         requests.append((f"req{i}", batch))
 
-    watchdog = None
-    if config.telemetry.stall_timeout_s > 0:
-        from tdfo_tpu.obs.watchdog import StallWatchdog
+    if fleet_mode:
+        # [serving] replicas > 1: the fleet quickstart — N frontends over
+        # one BundleStore, each following CURRENT and (with log_features)
+        # writing its own replica-<k> request log for the merged replay
+        from tdfo_tpu.serve.fleet import ServingFleet
+        from tdfo_tpu.serve.swap import BundleStore
 
-        watchdog = StallWatchdog(
-            Path(log_dir or config.checkpoint_dir or ".")
-            / "heartbeat_serve.jsonl",
-            config.telemetry.stall_timeout_s, label="serve").start()
+        store = BundleStore(base / "bundle_store")
+        if store.recover() is None:
+            store.ingest_full(out_dir)
+        flt = ServingFleet(
+            store, config, mesh=trainer.mesh, logger=trainer.logger,
+            request_log_root=(base / "request_log" if spec.log_features
+                              else None))
+        flt.sync()
+        t0 = time.monotonic()
+        flt.run(requests)
+        wall = time.monotonic() - t0
+        reps = [r for r in flt.alive() if r.batcher is not None]
+        lat = np.asarray([ms for r in reps for ms in r.batcher.latencies_ms],
+                         np.float64)
+        stats = {
+            "requests": int(lat.size),
+            "batches": sum(len(r.batcher.shipped) for r in reps),
+            "p50_ms": float(np.percentile(lat, 50)) if lat.size else 0.0,
+            "p99_ms": float(np.percentile(lat, 99)) if lat.size else 0.0,
+            "shed": sum(len(r.batcher.shed) for r in reps),
+            "swaps": sum(len(r.batcher.swaps) for r in reps),
+            "replicas": len(reps),
+            "version": store.current_version(),
+        }
+        if spec.log_features:
+            stats["request_log"] = str(base / "request_log")
+        flt.close()
+    else:
+        watchdog = None
+        if config.telemetry.stall_timeout_s > 0:
+            from tdfo_tpu.obs.watchdog import StallWatchdog
 
-    t0 = time.monotonic()
-    mb = MicroBatcher(
-        scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
-        batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
-        program_cache_size=scorer.score_cache_size,
-        max_queue=spec.max_queue, shed_policy=spec.shed_policy,
-        watchdog=watchdog, request_log=request_log)
-    mb.run(requests)
-    wall = time.monotonic() - t0
-    if watchdog is not None:
-        watchdog.stop()
-    stats = mb.stats()
-    if request_log is not None:
-        request_log.close()
-        stats["request_log"] = str(request_log.root)
+            watchdog = StallWatchdog(
+                base / "heartbeat_serve.jsonl",
+                config.telemetry.stall_timeout_s, label="serve").start()
+
+        t0 = time.monotonic()
+        mb = MicroBatcher(
+            scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
+            batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
+            program_cache_size=scorer.score_cache_size,
+            max_queue=spec.max_queue, shed_policy=spec.shed_policy,
+            watchdog=watchdog, request_log=request_log)
+        mb.run(requests)
+        wall = time.monotonic() - t0
+        if watchdog is not None:
+            watchdog.stop()
+        stats = mb.stats()
+        if request_log is not None:
+            request_log.close()
+            stats["request_log"] = str(request_log.root)
+        stats["programs"] = scorer.score_cache_size()
     stats["qps"] = stats["requests"] / wall if wall > 0 else float("inf")
-    stats["programs"] = scorer.score_cache_size()
     stats["bundle"] = str(out_dir)
     stats["step"] = int(step)
 

@@ -14,7 +14,14 @@ Layout under the store root::
     versions/v000000/   fully-materialized serving bundles (bundle.json +
     versions/v000001/   arrays.npz), published by directory rename
     CURRENT             {"version": N, "digest": ...} pointer, atomic JSON
-    quarantine.json     record of refused-corrupt deltas (never re-tried)
+    CANARY              same shape: the staged-rollout pointer the canary
+                        slice of the fleet serves while the gatekeeper
+                        watches (absent outside a watch window)
+    quarantine.json     record of refused-corrupt deltas
+    rejections.json     (version, digest) pairs the gatekeeper rolled back
+                        — recover() prunes their directories and never
+                        re-adopts them, so a rejected candidate's version
+                        NUMBER is reusable but its bytes are not
 
 Durability discipline — the ONLY sanctioned rename sites in the repo
 (``test_quality.py`` rejects bare ``os.rename``/``os.replace`` elsewhere):
@@ -28,6 +35,16 @@ A crash between stage and publish leaves only a ``*.tmp`` directory;
 :meth:`BundleStore.recover` deletes strays and re-points ``CURRENT`` at the
 newest version whose content digest verifies — so "restart the same
 command" converges, exactly like the trainer's kill-marker semantics.
+
+Canary ordering invariant: :meth:`BundleStore.publish_canary` writes the
+``CANARY`` pointer BEFORE publishing the version directory.  A crash in
+between leaves a pointer naming a missing directory (``recover()`` clears
+it; the supervisor's deterministic redo republishes identical bytes) —
+never an unnamed published directory that ``recover()``'s newest-first walk
+would wrongly adopt as ``CURRENT`` before the gatekeeper passed it.
+Promotion reverses that: ``CURRENT`` advances first, then ``CANARY``
+clears, so a canary pointer at or below ``CURRENT`` is a completed
+promotion, not a pending one.
 
 Failure degradation: a delta whose payload does not hash to its manifest
 digest is QUARANTINED (recorded, never applied, never crashes the
@@ -70,7 +87,9 @@ __all__ = [
 ]
 
 _CURRENT = "CURRENT"
+_CANARY = "CANARY"
 _QUARANTINE = "quarantine.json"
+_REJECTIONS = "rejections.json"
 
 
 class DeltaChainError(ValueError):
@@ -134,6 +153,12 @@ def _version_name(version: int) -> str:
     return f"v{version:06d}"
 
 
+def _read_manifest(vdir: Path) -> dict:
+    """Whole-file manifest read (NOT a line tailer — the quality suite
+    confines line-oriented json.loads loops to data/replay.py)."""
+    return json.loads((vdir / "bundle.json").read_text())
+
+
 class BundleStore:
     """Versioned, digest-verified bundle store with an atomic CURRENT pointer.
 
@@ -143,26 +168,53 @@ class BundleStore:
     fully-verified version.
     """
 
-    def __init__(self, root: str | Path):
+    def __init__(self, root: str | Path, *, keep_versions: int = 0):
+        if keep_versions < 0:
+            raise ValueError(
+                f"keep_versions must be >= 0 (0 = keep everything), "
+                f"got {keep_versions}")
         self.root = Path(root)
         self.versions = self.root / "versions"
         self.versions.mkdir(parents=True, exist_ok=True)
+        # retention budget beyond the protected CURRENT/CANARY chain
+        # ([serving] keep_versions); 0 disables gc_versions entirely
+        self.keep_versions = int(keep_versions)
 
     # ------------------------------------------------------------ queries
 
-    def current_version(self) -> int | None:
-        cur = self.root / _CURRENT
-        if not cur.exists():
+    def _read_pointer(self, name: str) -> dict | None:
+        p = self.root / name
+        if not p.exists():
             return None
-        return int(json.loads(cur.read_text())["version"])
+        rec = json.loads(p.read_text())
+        return {"version": int(rec["version"]), "digest": rec["digest"]}
+
+    def current_version(self) -> int | None:
+        cur = self._read_pointer(_CURRENT)
+        return None if cur is None else cur["version"]
 
     def current_dir(self) -> Path | None:
         v = self.current_version()
         return None if v is None else self.versions / _version_name(v)
 
+    def canary_version(self) -> int | None:
+        can = self._read_pointer(_CANARY)
+        return None if can is None else can["version"]
+
+    def canary_dir(self) -> Path | None:
+        v = self.canary_version()
+        return None if v is None else self.versions / _version_name(v)
+
     def quarantined(self) -> list[dict]:
         qpath = self.root / _QUARANTINE
         return json.loads(qpath.read_text()) if qpath.exists() else []
+
+    def rejections(self) -> list[dict]:
+        rpath = self.root / _REJECTIONS
+        return json.loads(rpath.read_text()) if rpath.exists() else []
+
+    def _rejected_keys(self) -> set[tuple[int, str]]:
+        return {(int(r["version"]), r["digest"]) for r in self.rejections()}
 
     def _read_current(self) -> tuple[dict, dict[str, np.ndarray]]:
         cdir = self.current_dir()
@@ -228,6 +280,17 @@ class BundleStore:
         CURRENT is untouched until the composed bundle is fully staged,
         fsynced, published, and digest-verified.
         """
+        manifest, arrays = self.compose_delta(delta_dir)
+        self._publish(manifest, arrays, int(manifest["version"]), is_swap=True)
+        return int(manifest["version"])
+
+    def compose_delta(self, delta_dir: str | Path
+                      ) -> tuple[dict, dict[str, np.ndarray]]:
+        """Verify a delta end-to-end (own digest, chain position, base
+        bytes) and compose it onto CURRENT **in memory** — nothing is
+        published.  The gated supervisor scores this composition on the
+        shadow slice before any pointer moves; :meth:`apply_delta` and
+        :meth:`publish_canary` both build on it."""
         delta_dir = Path(delta_dir)
         dmanifest, darrays = retry_call(
             read_raw_bundle, delta_dir,
@@ -270,27 +333,219 @@ class BundleStore:
             if "out of order" in msg or "parent digest" in msg:
                 raise DeltaChainError(msg) from e
             raise CorruptDeltaError(msg) from e
-        self._publish(manifest, arrays, int(manifest["version"]), is_swap=True)
-        return int(manifest["version"])
+        return manifest, arrays
+
+    # ------------------------------------------------------------- canary
+
+    def publish_canary(self, delta_dir: str | Path,
+                       composed: tuple[dict, dict[str, np.ndarray]] | None
+                       = None) -> int:
+        """Publish a gated candidate under the ``CANARY`` pointer; CURRENT
+        is untouched.  ``composed`` reuses the (manifest, arrays) the
+        shadow gate already verified via :meth:`compose_delta`.
+
+        Pointer-first ordering + deterministic re-export make this
+        redoable: a restarted supervisor recomposes identical bytes, finds
+        the pointer naming the same digest and either adopts the already-
+        published directory or re-stages it — a kill at ANY byte of a
+        canary publish converges on retry."""
+        manifest, arrays = (composed if composed is not None
+                            else self.compose_delta(delta_dir))
+        version = int(manifest["version"])
+        final = self.versions / _version_name(version)
+        atomic_write_json(self.root / _CANARY,
+                          {"version": version, "digest": manifest["digest"]})
+        if final.exists():
+            try:
+                m, a = read_raw_bundle(final)
+                if (bundle_digest(m, a) == m.get("digest")
+                        == manifest["digest"]):
+                    return version  # redo after a kill: already published
+            except Exception:
+                pass
+            shutil.rmtree(final)  # torn or stale bytes at this version
+        staged = self.versions / (_version_name(version) + ".tmp")
+        if staged.exists():
+            shutil.rmtree(staged)
+        write_raw_bundle(staged, manifest, arrays)
+        inj = faults.active()
+        if inj is not None:
+            inj.maybe_kill_swap()  # same half-applied crash point as CURRENT
+        publish_dir(staged, final)
+        return version
+
+    def promote_canary(self) -> int | None:
+        """Advance ``CURRENT`` to the watched canary version (digest-
+        re-verified from disk) and clear the ``CANARY`` pointer.
+        Idempotent: with no pending canary — or one at/below CURRENT, the
+        crashed-between-pointer-writes window — it just clears and returns
+        the serving head."""
+        can = self._read_pointer(_CANARY)
+        cur = self.current_version()
+        if can is None:
+            return cur
+        if cur is not None and can["version"] <= cur:
+            (self.root / _CANARY).unlink(missing_ok=True)
+            return cur
+        vdir = self.versions / _version_name(can["version"])
+        manifest, arrays = retry_call(
+            read_raw_bundle, vdir, description=f"canary read {vdir.name}")
+        got = bundle_digest(manifest, arrays)
+        if got != can["digest"]:
+            raise ValueError(
+                f"canary {vdir.name}: payload hashes to {got}, pointer says "
+                f"{can['digest']!r} — refusing to promote corrupt bytes")
+        atomic_write_json(self.root / _CURRENT,
+                          {"version": can["version"], "digest": can["digest"]})
+        (self.root / _CANARY).unlink(missing_ok=True)
+        self.gc_versions()
+        return can["version"]
+
+    def rollback_canary(self, reason: str) -> int | None:
+        """Reject the pending canary: record its ``(version, digest)`` in
+        ``rejections.json`` (durable FIRST — recover() then prunes the
+        directory even if this process dies mid-rollback), delete its
+        directory so the version number is reusable by the next candidate,
+        clear ``CANARY``, and digest-verify that CURRENT still serves the
+        last good bytes — the bitwise rollback guarantee.  Idempotent:
+        with no pending canary only the CURRENT verification runs."""
+        can = self._read_pointer(_CANARY)
+        if can is not None:
+            self._record_rejection(can["version"], can["digest"], reason)
+            vdir = self.versions / _version_name(can["version"])
+            if vdir.exists():
+                shutil.rmtree(vdir)
+            (self.root / _CANARY).unlink(missing_ok=True)
+        cdir = self.current_dir()
+        if cdir is not None:
+            manifest, arrays = self._read_current()
+            got = bundle_digest(manifest, arrays)
+            if got != manifest.get("digest"):
+                raise ValueError(
+                    f"rollback target v{manifest.get('version')}: payload "
+                    f"hashes to {got}, manifest says "
+                    f"{manifest.get('digest')!r} — the last good version is "
+                    "itself corrupt")
+        return self.current_version()
+
+    def _record_rejection(self, version: int, digest: str,
+                          reason: str) -> None:
+        rec = {"version": int(version), "digest": digest,
+               "reason": reason, "time": time.time()}
+        existing = self.rejections()
+        if any(r["version"] == rec["version"] and r["digest"] == rec["digest"]
+               for r in existing):
+            return  # redo of a crashed rollback: already recorded
+        atomic_write_json(self.root / _REJECTIONS, existing + [rec])
+
+    # ---------------------------------------------------------- retention
+
+    def gc_versions(self) -> list[int]:
+        """Retention sweep ([serving] keep_versions): beyond the protected
+        CURRENT/CANARY chain, keep only the ``keep_versions`` newest
+        published directories.  CURRENT's bytes are digest-verified BEFORE
+        anything is deleted — a sweep never removes fallback history while
+        the serving head is corrupt.  Returns the pruned versions."""
+        if not self.keep_versions:
+            return []
+        protect = {v for v in (self.current_version(), self.canary_version())
+                   if v is not None}
+        try:
+            manifest, arrays = self._read_current()
+            if bundle_digest(manifest, arrays) != manifest.get("digest"):
+                return []  # corrupt head: recover(), don't prune history
+        except Exception:
+            return []
+        listed: list[tuple[int, Path]] = []
+        for vdir in self.versions.iterdir():
+            if vdir.is_dir() and not vdir.name.endswith(".tmp"):
+                try:
+                    listed.append((int(vdir.name.lstrip("v")), vdir))
+                except ValueError:
+                    continue
+        listed.sort(reverse=True)
+        pruned: list[int] = []
+        survivors = 0
+        for version, vdir in listed:
+            if version in protect:
+                continue
+            if survivors < self.keep_versions:
+                survivors += 1
+                continue
+            shutil.rmtree(vdir)
+            pruned.append(version)
+        return pruned
 
     # ----------------------------------------------------------- recovery
 
     def recover(self) -> int | None:
         """Restart-after-crash entry point: delete stray ``*.tmp`` staging
-        directories, walk published versions newest-first, and point CURRENT
-        at the first one whose content digest verifies (pruning any newer
-        corrupt/torn directory).  Returns the recovered version, or ``None``
-        for an empty store."""
+        directories and gatekeeper-rejected version directories, validate
+        the ``CANARY`` pointer (cleared when it names rejected, missing,
+        corrupt, or already-promoted bytes), then walk published versions
+        newest-first — EXCLUDING a surviving canary, which is staged but
+        unvetted — and point CURRENT at the first one whose content digest
+        verifies (pruning any newer corrupt/torn directory).  Ends with the
+        retention sweep.  Returns the recovered version, or ``None`` for an
+        empty store."""
         for stray in self.versions.glob("*.tmp"):
             shutil.rmtree(stray)
+        rejected = self._rejected_keys()
+        if rejected:
+            # a crash between rejection record and directory delete leaves
+            # the rolled-back bytes on disk; finish the delete here so the
+            # walk below can never re-adopt them
+            for vdir in list(self.versions.iterdir()):
+                if not vdir.is_dir():
+                    continue
+                try:
+                    manifest = _read_manifest(vdir)
+                    key = (int(manifest["version"]), manifest.get("digest"))
+                except Exception:
+                    continue  # torn directory: the walk below prunes it
+                if key in rejected:
+                    shutil.rmtree(vdir)
+        canary_v: int | None = None
+        can = self._read_pointer(_CANARY)
+        cur_ptr = self._read_pointer(_CURRENT)
+        if (can is not None and cur_ptr is not None
+                and can["version"] <= cur_ptr["version"]):
+            # promotion advanced CURRENT but crashed before clearing the
+            # canary pointer: the candidate IS the vetted head now, so the
+            # pointer is a completed promotion's leftover, not a pending one
+            (self.root / _CANARY).unlink(missing_ok=True)
+            can = None
+        if can is not None:
+            cdir = self.versions / _version_name(can["version"])
+            ok = False
+            if (can["version"], can["digest"]) not in rejected and cdir.exists():
+                try:
+                    manifest, arrays = read_raw_bundle(cdir)
+                    ok = (bundle_digest(manifest, arrays)
+                          == manifest.get("digest") == can["digest"])
+                except Exception:
+                    ok = False
+                if not ok and cdir.exists():
+                    shutil.rmtree(cdir)
+            if ok:
+                canary_v = can["version"]
+            else:
+                # pointer-before-directory crash window (or a rejected /
+                # corrupt candidate): the supervisor's redo republishes
+                (self.root / _CANARY).unlink(missing_ok=True)
         best: tuple[int, dict] | None = None
         for vdir in sorted(self.versions.iterdir(), reverse=True):
             if not vdir.is_dir():
                 continue
+            if canary_v is not None and vdir.name == _version_name(canary_v):
+                continue  # staged but unvetted: never the serving head
             try:
                 manifest, arrays = read_raw_bundle(vdir)
                 if bundle_digest(manifest, arrays) != manifest.get("digest"):
                     raise ValueError("digest mismatch")
+                if (int(manifest["version"]),
+                        manifest.get("digest")) in rejected:
+                    raise ValueError("gatekeeper-rejected bytes")
                 best = (int(manifest["version"]), manifest)
                 break
             except Exception:
@@ -300,10 +555,19 @@ class BundleStore:
             cur = self.root / _CURRENT
             if cur.exists():
                 cur.unlink()
+            if canary_v is not None:
+                # a canary with no base to fall back on is unservable
+                (self.root / _CANARY).unlink(missing_ok=True)
+                shutil.rmtree(self.versions / _version_name(canary_v),
+                              ignore_errors=True)
             return None
         version, manifest = best
+        if canary_v is not None and canary_v <= version:
+            # promotion completed before the crash cleared the pointer
+            (self.root / _CANARY).unlink(missing_ok=True)
         atomic_write_json(self.root / _CURRENT,
                           {"version": version, "digest": manifest["digest"]})
+        self.gc_versions()
         return version
 
     def record_quarantine(self, delta_dir: str | Path, error: str) -> None:
@@ -431,5 +695,16 @@ class SwapController:
         if nxt is None:
             return False
         if any(q["path"] == str(nxt) for q in self.store.quarantined()):
-            return False  # quarantined deltas are never re-tried by polling
+            # a quarantined PATH is re-tried only when the bytes on disk
+            # have verifiably changed since the refusal (the exporter
+            # re-wrote a good delta at the same chain position) — that is
+            # how a degraded frontend recovers without an operator poke,
+            # while still-corrupt bytes are never re-applied in a loop
+            try:
+                m, a = read_raw_bundle(nxt)
+                if (m.get("kind") != "delta"
+                        or bundle_digest(m, a) != m.get("digest")):
+                    return False
+            except Exception:
+                return False
         return self.apply(nxt)

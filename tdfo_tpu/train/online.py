@@ -34,6 +34,36 @@ matrix is deterministic, and every cycle logs an ``online_cycle`` record —
 consumed ``(seq, row_start, row_end)`` spans plus the ``replay/*`` counters
 — through the trainer's ``metrics.jsonl`` (PR-7 telemetry path), which is
 the record-id accounting the no-dup/no-loss test audits.
+
+The GATED mode (``[online] canary_cycles > 0``, requires a multi-replica
+``[serving] replicas`` fleet) puts a canary gatekeeper between training
+and serving, the deployment discipline Monolith §3.3 describes for its
+online models.  Cycle stages become
+
+    replay -> train -> export -> publish -> canary -> verdict -> commit -> swap
+
+with the VERDICT CHECKPOINT as the single durability point: (1) a shadow
+slice of held-out replayed traffic (``ReplayConsumer.peek_batches`` —
+rows PAST the committed cursor, which train only in a LATER cycle, i.e.
+progressive validation) scores every candidate against the incumbent
+before any pointer moves, refusing on AUC regression beyond ``[online]
+max_auc_regression``; (2) survivors publish under the ``CANARY`` pointer,
+picked up by only the first ``canary_fraction`` of the
+``serve/fleet.ServingFleet`` replicas; (3) ``canary_cycles`` watch rounds
+compare per-replica held-out-AUC heartbeats (latency recorded alongside)
+canary-vs-stable — training/serving skew that byte-perfect bundles can't
+reveal shows up here; (4) promote moves ``CURRENT`` and rollback deletes
+the candidate, records it in ``rejections.json`` and digest-verifies that
+every replica converges bitwise back onto the last good version.  A
+rejected cycle still advances the replay cursor and the durable
+``cycles_done`` counter (consumed-but-discarded, recorded in metrics), so
+a persistently bad stream cannot wedge the loop, and the trained state is
+restored from the previous verdict checkpoint — version numbers are
+REUSED by the next candidate, keeping the delta chain strictly parent+1.
+A kill anywhere before the verdict checkpoint redoes the whole cycle
+deterministically (same records, bit-identical retrain, identical delta
+digest, idempotent ``publish_canary``); a kill after it is repaired by
+``_catch_up_gated`` replaying the recorded verdict onto the store.
 """
 
 from __future__ import annotations
@@ -69,7 +99,7 @@ class OnlineLoop:
     def __init__(self, config, *, log_dir: str | Path | None = None):
         import jax
 
-        from tdfo_tpu.data.replay import ReplayConsumer
+        from tdfo_tpu.data.replay import ReplayConsumer, make_replay_consumer
         from tdfo_tpu.serve.swap import BundleStore
         from tdfo_tpu.train.trainer import Trainer
 
@@ -104,10 +134,12 @@ class OnlineLoop:
             raise ValueError("online requires checkpoint_dir")
 
         self.workdir = Path(config.checkpoint_dir)
-        self.store = BundleStore(self.workdir / "bundle_store")
+        self.store = BundleStore(self.workdir / "bundle_store",
+                                 keep_versions=config.serving.keep_versions)
         self.store.recover()  # half-published strays from a killed publish
         self.chain = self.workdir / "delta_chain"
         self.chain.mkdir(parents=True, exist_ok=True)
+        self.gated = config.online.canary_cycles > 0
 
         # restore: state + replay cursor land together, so a resumed process
         # continues at the exact record the durable state has seen
@@ -118,9 +150,16 @@ class OnlineLoop:
                 self.trainer.state, stamps=self.trainer._ckpt_stamps)
         replay_cursor = (cursor or {}).get("replay")
         self._claimed_version = int((cursor or {}).get("target_version") or 0)
+        self.cycles_done = int((cursor or {}).get("cycles_done") or 0)
+        self._pending_canary = (cursor or {}).get("canary")
 
         mesh = self.trainer.mesh
-        self.consumer = ReplayConsumer(
+        # a multi-replica fleet writes one request log per replica
+        # (<root>/replica-<k>); the factory folds them into one
+        # exactly-once stream keyed (replica_id, seq)
+        consumer_cls = (make_replay_consumer if config.serving.replicas > 1
+                        else ReplayConsumer)
+        self.consumer = consumer_cls(
             config.online.request_log,
             schema=self.trainer._eval_schema,
             batch_size=config.per_device_train_batch_size
@@ -131,8 +170,31 @@ class OnlineLoop:
             cursor=replay_cursor,
         )
         self._bootstrap_store()
-        self._catch_up()
-        self.batcher = self._make_batcher()
+        if self.gated and self.trainer._ckpt.latest_step() is None:
+            # rollback anchor: gated cycle 1 needs a last-good state to
+            # restore on rejection, so the pristine state is durable BEFORE
+            # any gated training
+            self.trainer._ckpt.save(
+                0, self.trainer.state, force=True,
+                cursor={"online": True, "global_step": 0, "cycles_done": 0,
+                        "replay": self.consumer.cursor(),
+                        "target_version":
+                        int(self.store.current_version() or 0)},
+                stamps=self.trainer._ckpt_stamps)
+        if self.gated:
+            self._catch_up_gated()
+        else:
+            self._catch_up()
+        self.fleet = None
+        if config.serving.replicas > 1:
+            from tdfo_tpu.serve.fleet import ServingFleet
+
+            self.fleet = ServingFleet(self.store, config, mesh=mesh,
+                                      logger=self.trainer.logger)
+            self.fleet.sync()
+            self.batcher = None
+        else:
+            self.batcher = self._make_batcher()
         self.cycles = 0
 
     # ----------------------------------------------------------- store side
@@ -192,6 +254,31 @@ class OnlineLoop:
         if self._claimed_version <= int(self.store.current_version() or 0):
             return
         self._publish_state(self._claimed_version)
+
+    def _catch_up_gated(self) -> None:
+        """Repair a kill between the gated VERDICT checkpoint and the store
+        commit: the checkpoint records the verdict durably; the store-side
+        promote/rollback replays idempotently here.  Identity is the
+        verdict's ``(version, digest)`` pair — version numbers are reused
+        after a rollback, so a LATER cycle's pending canary carrying the
+        same number (different bytes) must not be judged by an old
+        verdict.  The gated mode never runs the non-gated ``_catch_up``:
+        a claimed-but-unpromoted version already exists as the canary
+        directory, so the repair is a pointer move, not a re-export."""
+        pc = self._pending_canary
+        if not pc:
+            return
+        verdict = pc.get("verdict")
+        if verdict == "promote":
+            if int(self.store.current_version() or 0) < int(pc["version"]):
+                self.store.promote_canary()
+        elif verdict == "rollback":
+            ptr = self.store._read_pointer("CANARY")
+            if ptr is not None and (ptr["version"], ptr["digest"]) == (
+                    int(pc["version"]), pc["digest"]):
+                self.store.rollback_canary(
+                    str(pc.get("reason") or "auto-rollback (replayed)"))
+        # "rejected" never published — nothing on the store side to redo
 
     def _make_batcher(self):
         from tdfo_tpu.serve.frontend import MicroBatcher
@@ -273,30 +360,242 @@ class OnlineLoop:
         self._publish_state(target)  # stages: export -> publish
 
         _stage("swap")
-        scorer = self._build_scorer(self.store.current_dir())
-        self.batcher.swap(scorer.score, version=target,
-                          program_cache_size=scorer.score_cache_size)
+        if self.fleet is not None:
+            # ungated fleet: every replica follows the freshly-moved CURRENT
+            self.fleet.sync()
+        else:
+            scorer = self._build_scorer(self.store.current_dir())
+            self.batcher.swap(scorer.score, version=target,
+                              program_cache_size=scorer.score_cache_size)
         self.cycles += 1
         return rec
 
-    def run(self) -> dict[str, Any]:
-        """Cycle until the log drains or ``max_cycles``; returns run stats."""
-        max_cycles = self.config.online.max_cycles
-        while not max_cycles or self.cycles < max_cycles:
-            if self.run_cycle() is None:
+    # ------------------------------------------------------- the gated cycle
+
+    def _score_batches(self, scorer, batches: list[dict[str, np.ndarray]]
+                       ) -> np.ndarray:
+        """Score replay batches on a scorer, label-stripped.  The jitted
+        score donates its inputs, so every call gets fresh arrays."""
+        outs = []
+        for b in batches:
+            feats = {k: np.array(v) for k, v in b.items() if k != "label"}
+            outs.append(np.asarray(scorer.score(feats)))
+        return np.concatenate(outs)
+
+    def _restore_last_good(self) -> None:
+        """Discard the cycle's trained state: reload the last durable state
+        (the previous verdict checkpoint, or the gated anchor).  ``gstep``
+        is NOT rewound — checkpoint ids stay monotonic, and a restarted
+        redo recomputes the identical ids from the identical records."""
+        _, self.trainer.state, _ = self.trainer._ckpt.restore(
+            self.trainer.state, stamps=self.trainer._ckpt_stamps)
+
+    def _corrupt_candidate(self, delta_dir: Path) -> None:
+        """The ``corrupt_candidate`` fault body: flip one payload byte of
+        the ON-DISK delta (manifest digest left stale), so the gate's
+        ``compose_delta`` digest check runs against real corruption."""
+        from tdfo_tpu.serve.export import read_raw_bundle, write_raw_bundle
+
+        manifest, arrays = read_raw_bundle(delta_dir)
+        name = sorted(arrays)[0]
+        arr = arrays[name]
+        raw = bytearray(arr.tobytes())
+        raw[len(raw) // 2] ^= 0xFF
+        arrays[name] = np.frombuffer(bytes(raw),
+                                     dtype=arr.dtype).reshape(arr.shape)
+        shutil.rmtree(delta_dir)
+        write_raw_bundle(delta_dir, manifest, arrays)
+
+    def _run_cycle_gated(self) -> dict[str, Any] | None:
+        """One gatekept cycle (see the module docstring for the contract):
+        shadow-gate the candidate, canary it on the fleet's canary cohort,
+        then promote or roll back — with the verdict checkpoint as the
+        cycle's single durability point.  Returns ``None`` (nothing
+        committed, nothing trained into the durable lineage) when the log
+        lacks a full cycle of train rows plus the held-out shadow slice."""
+        from tdfo_tpu.serve.export import bundle_from_raw, export_delta
+        from tdfo_tpu.serve.scoring import make_scorer
+        from tdfo_tpu.serve.swap import CorruptDeltaError, _version_name
+        from tdfo_tpu.train.metrics import binary_auc
+
+        cfg = self.config
+        inj = _faults.active()
+        cycle_no = self.cycles_done + 1
+
+        _stage("replay")
+        self.consumer.check_backpressure()
+        batches, consumed = [], []
+        while len(batches) < cfg.online.steps_per_cycle:
+            out = self.consumer.next_batch()
+            if out is None:
                 break
+            batches.append(out[0])
+            consumed.extend(out[1])
+        if not batches:
+            return None
+        # the shadow-eval slice: held-out traffic PAST the cursor (it
+        # trains in a later cycle, never this one — progressive validation)
+        shadow = self.consumer.peek_batches(cfg.online.shadow_eval_batches)
+        if len(shadow) < cfg.online.shadow_eval_batches:
+            return None  # no commit: wait until the held-out slice fills
+        shadow_labels = np.concatenate([b["label"] for b in shadow])
+        shadow_feats = {k: np.concatenate([b[k] for b in shadow])
+                        for k in shadow[0] if k != "label"}
+
+        _stage("train")
+        loss = self._train_cycle(batches)
+
+        _stage("export")
+        target = int(self.store.current_version() or 0) + 1
+        delta_dir = self.chain / _version_name(target)
+        if delta_dir.exists():
+            shutil.rmtree(delta_dir)
+        export_delta(delta_dir, self.store.current_dir(),
+                     **self._export_kwargs())
+        if inj is not None and inj.corrupt_candidate_due():
+            self._corrupt_candidate(delta_dir)
+        try:
+            manifest, arrays = self.store.compose_delta(delta_dir)
+        except CorruptDeltaError as err:
+            # a corrupt candidate never reaches a pointer: re-export from
+            # the in-memory state (deterministic) and re-verify — a second
+            # failure means the corruption is upstream of the disk, so die
+            self.trainer.logger.log(event="candidate_corrupt",
+                                    cycle=cycle_no, version=target,
+                                    error=str(err))
+            shutil.rmtree(delta_dir)
+            export_delta(delta_dir, self.store.current_dir(),
+                         **self._export_kwargs())
+            manifest, arrays = self.store.compose_delta(delta_dir)
+        digest = manifest["digest"]
+
+        # shadow gate: candidate vs incumbent on the same held-out rows
+        candidate = make_scorer(
+            bundle_from_raw(manifest, arrays, source=str(delta_dir)),
+            mesh=self.trainer.mesh)
+        incumbent = self._build_scorer(self.store.current_dir())
+        auc_cand = binary_auc(shadow_labels,
+                              self._score_batches(candidate, shadow))
+        auc_base = binary_auc(shadow_labels,
+                              self._score_batches(incumbent, shadow))
+
+        verdict, reason = "promote", ""
+        canary_auc = stable_auc = None
+        if auc_cand < auc_base - cfg.online.max_auc_regression:
+            verdict = "rejected"
+            reason = (f"shadow gate: candidate AUC {auc_cand:.4f} < "
+                      f"incumbent {auc_base:.4f} - "
+                      f"{cfg.online.max_auc_regression}")
+        else:
+            if inj is not None and inj.auc_regress_due(cycle_no):
+                # training/serving skew: the BYTES are healthy (the shadow
+                # gate scored them directly and passed) — only live serving
+                # misbehaves, which is what the canary watch exists for
+                self.fleet.set_score_skew(digest)
+            _stage("publish")
+            self.store.publish_canary(delta_dir, composed=(manifest, arrays))
+            _stage("canary")
+            self.fleet.sync()  # the canary cohort picks the candidate up
+            for rnd in range(1, cfg.online.canary_cycles + 1):
+                if inj is not None:
+                    inj.maybe_kill_canary(rnd)
+                self.fleet.mark_canary_watch()
+                self.fleet.sync()
+                hbs = self.fleet.heartbeat(shadow_feats, shadow_labels)
+                for hb in hbs:
+                    self.trainer.logger.log(event="canary_heartbeat",
+                                            cycle=cycle_no, round=rnd, **hb)
+                canaries = [h for h in hbs
+                            if h["canary"] and h["version"] == target]
+                stables = [h for h in hbs if not h["canary"]]
+                if not canaries:
+                    verdict, reason = "rollback", "no alive canary replica"
+                    break
+                canary_auc = float(np.mean([h["auc"] for h in canaries]))
+                stable_auc = (float(np.mean([h["auc"] for h in stables]))
+                              if stables else auc_base)
+                if canary_auc < stable_auc - cfg.online.max_auc_regression:
+                    verdict = "rollback"
+                    reason = (f"canary AUC {canary_auc:.4f} < stable "
+                              f"{stable_auc:.4f} - "
+                              f"{cfg.online.max_auc_regression} at watch "
+                              f"round {rnd}")
+                    break
+
+        _stage("verdict")
+        if verdict != "promote":
+            self._restore_last_good()
+        canary_rec = {"verdict": verdict, "version": target,
+                      "digest": digest, "reason": reason}
+        self.trainer._ckpt.save(
+            self.gstep, self.trainer.state, force=True,
+            cursor={"online": True, "global_step": self.gstep,
+                    "cycles_done": cycle_no,
+                    "replay": self.consumer.cursor(),
+                    "target_version": target if verdict == "promote"
+                    else int(self.store.current_version() or 0),
+                    "canary": canary_rec},
+            stamps=self.trainer._ckpt_stamps)
+        self._pending_canary = canary_rec
+
+        _stage("commit")
+        if verdict == "promote":
+            self.store.promote_canary()
+        elif verdict == "rollback":
+            self.store.rollback_canary(reason)
+
+        _stage("swap")
+        self.fleet.sync()  # every replica converges on the verdict's head
+        if cfg.online.keep_consumed_segments > 0:
+            self.consumer.gc_consumed_segments(
+                cfg.online.keep_consumed_segments)
+        self.cycles_done = cycle_no
+        self.cycles += 1
+        rec = {
+            "event": "online_cycle", "cycle": cycle_no, "gated": True,
+            "global_step": self.gstep, "steps": len(batches), "loss": loss,
+            "verdict": verdict, "reason": reason, "version": target,
+            "shadow_auc": auc_cand, "shadow_auc_base": auc_base,
+            "canary_auc": canary_auc, "stable_auc": stable_auc,
+            "consumed": [list(span) for span in consumed],
+            **self.consumer.counters(),
+        }
+        self.trainer.logger.log(**rec)
+        return rec
+
+    def run(self) -> dict[str, Any]:
+        """Cycle until the log drains or ``max_cycles``; returns run stats.
+        The gated loop counts DURABLE cycles (``cycles_done`` rides in the
+        verdict checkpoint) so a restarted run finishes the budget instead
+        of re-running it."""
+        max_cycles = self.config.online.max_cycles
+        if self.gated:
+            while not max_cycles or self.cycles_done < max_cycles:
+                if self._run_cycle_gated() is None:
+                    break
+        else:
+            while not max_cycles or self.cycles < max_cycles:
+                if self.run_cycle() is None:
+                    break
         ctrs = self.consumer.counters()
-        return {
+        out = {
             "cycles": self.cycles,
             "global_step": self.gstep,
             "version": int(self.store.current_version() or 0),
             "bundle": str(self.store.current_dir()),
             **ctrs,
         }
+        if self.gated:
+            out["cycles_done"] = self.cycles_done
+        return out
 
     def probe(self, requests) -> dict[Any, np.ndarray]:
-        """Score a request trace through the live (post-swap) batcher — the
-        served-logits fingerprint the bitwise-equality acceptance compares."""
+        """Score a request trace through the live (post-swap) serving side —
+        the served-logits fingerprint the bitwise-equality acceptance
+        compares.  In fleet mode the trace round-robins over alive
+        replicas (``fleet.probe_each`` gives the per-replica variant)."""
+        if self.fleet is not None:
+            return self.fleet.run(requests)
         return self.batcher.run(requests)
 
 

@@ -62,6 +62,31 @@ and ``tdfo_tpu/train/online.py``) exercise the request-log tail:
     ``kill_during_replay`` and ``kill_during_swap`` this covers every edge
     of the serve -> retrain -> delta-export -> swap cycle.
 
+The canary-gatekeeper triggers (consulted by ``tdfo_tpu/train/online.py``
+and ``tdfo_tpu/serve/fleet.py``) drive the fleet rollout state machine:
+
+  * ``corrupt_candidate = N``  — the Nth candidate delta the gated
+    supervisor exports has its ON-DISK payload bit-flipped (once per
+    process), so the pre-publish shadow gate verifies real corruption and
+    the re-export repair path runs — the exporter-side twin of
+    ``corrupt_delta_nth``.
+  * ``regress_auc_at_cycle = N``  — the candidate of gated cycle N serves
+    garbage on the replicas that load it (the fleet replaces its logits
+    with a feature heuristic: training/serving skew).  Keyed on the
+    DURABLE cycle number from the verdict checkpoint, so a killed-and-
+    restarted run re-injects the regression at exactly the same cycle.
+  * ``kill_during_canary = N``  — hard-kill at the start of the Nth canary
+    watch round, after the candidate reached the canary replicas but
+    before any verdict is durable; one-shot per workdir via a
+    ``faults_canary_kill.marker`` sentinel.  The restart must redo the
+    whole cycle from the last verdict checkpoint and converge to the
+    uninterrupted run's fleet state.
+  * ``kill_replica_nth = K``  — replica K-1 (1-based K) drops dead at the
+    first canary watch round it participates in.  An in-process soft kill
+    (the replica stops syncing/serving; NO ``os._exit`` — the supervisor
+    process survives), re-fired deterministically on every restart so
+    killed and uninterrupted lineages see the same fleet membership.
+
 All training triggers key on run-global DATA position (batches consumed),
 which is monotone across rollbacks and resumes — ``state.step`` is not
 (rollback rewinds it).  Zero disables a trigger; a process with no faults
@@ -84,6 +109,7 @@ _MARKER = "faults_kill.marker"
 _SWAP_MARKER = "faults_swap_kill.marker"
 _REPLAY_MARKER = "faults_replay_kill.marker"
 _STAGE_MARKER = "faults_stage_kill.marker"
+_CANARY_MARKER = "faults_canary_kill.marker"
 
 
 @dataclass(frozen=True)
@@ -104,6 +130,10 @@ class FaultSpec:
     corrupt_record_nth: int = 0
     kill_during_replay: int = 0
     kill_between_stages: int = 0
+    corrupt_candidate: int = 0
+    regress_auc_at_cycle: int = 0
+    kill_during_canary: int = 0
+    kill_replica_nth: int = 0
 
     def __post_init__(self) -> None:
         for name in ("kill_at_step", "nan_at_step", "fail_io_nth",
@@ -111,7 +141,9 @@ class FaultSpec:
                      "slow_score_ms", "kill_during_swap",
                      "truncate_log_at_byte", "dup_record_nth",
                      "corrupt_record_nth", "kill_during_replay",
-                     "kill_between_stages"):
+                     "kill_between_stages", "corrupt_candidate",
+                     "regress_auc_at_cycle", "kill_during_canary",
+                     "kill_replica_nth"):
             if getattr(self, name) < 0:
                 raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
 
@@ -121,7 +153,9 @@ class FaultSpec:
                     or self.corrupt_delta_nth or self.slow_score_ms
                     or self.kill_during_swap or self.truncate_log_at_byte
                     or self.dup_record_nth or self.corrupt_record_nth
-                    or self.kill_during_replay or self.kill_between_stages)
+                    or self.kill_during_replay or self.kill_between_stages
+                    or self.corrupt_candidate or self.regress_auc_at_cycle
+                    or self.kill_during_canary or self.kill_replica_nth)
 
 
 class FaultInjector:
@@ -142,6 +176,10 @@ class FaultInjector:
         self._rec_corrupt_count = 0
         self._rec_corrupt_fired = False
         self._stage_count = 0
+        self._candidate_count = 0
+        self._candidate_fired = False
+        self._canary_count = 0
+        self._replica_kill_fired = False
 
     # ------------------------------------------------------------- kill
 
@@ -339,6 +377,72 @@ class FaultInjector:
         print(f"[faults] injected kill before stage {stage!r} (boundary "
               f"#{self._stage_count})", flush=True)
         os._exit(KILL_EXIT_CODE)
+
+    # ------------------------------------------------------------- canary
+
+    def corrupt_candidate_due(self) -> bool:
+        """Called by the gated supervisor once per exported candidate delta.
+        True exactly once, on the configured Nth export — the caller then
+        bit-flips the ON-DISK payload so the shadow gate's digest check and
+        the re-export repair path run against real corruption."""
+        if not self.spec.corrupt_candidate or self._candidate_fired:
+            return False
+        self._candidate_count += 1
+        if self._candidate_count == self.spec.corrupt_candidate:
+            self._candidate_fired = True
+            print(f"[faults] corrupting candidate export "
+                  f"#{self._candidate_count}", flush=True)
+            return True
+        return False
+
+    def auc_regress_due(self, cycle: int) -> bool:
+        """True when the candidate of gated cycle ``cycle`` should serve
+        garbage (training/serving skew).  Pure compare on the DURABLE cycle
+        number — no process state, so a restarted redo of the same cycle
+        re-injects the identical regression."""
+        return bool(self.spec.regress_auc_at_cycle
+                    and cycle == self.spec.regress_auc_at_cycle)
+
+    def canary_kill_due(self, rnd: int) -> bool:
+        """True when the mid-canary kill should fire on THIS watch round
+        (counts rounds crossed; honours the one-shot marker); does NOT
+        exit."""
+        if not self.spec.kill_during_canary:
+            return False
+        if self.workdir is not None and (self.workdir / _CANARY_MARKER).exists():
+            return False
+        self._canary_count += 1
+        return self._canary_count == self.spec.kill_during_canary
+
+    def maybe_kill_canary(self, rnd: int) -> None:
+        """Hard-exit at the start of a canary watch round — the candidate
+        reached the canary replicas but no verdict is durable, so the
+        restart must redo the cycle and converge to the uninterrupted
+        run's verdict."""
+        if not self.canary_kill_due(rnd):
+            return
+        if self.workdir is not None:
+            self.workdir.mkdir(parents=True, exist_ok=True)
+            (self.workdir / _CANARY_MARKER).write_text(
+                f"killed during canary watch round {rnd} (boundary "
+                f"#{self._canary_count}) at {time.time()}\n"
+            )
+        print(f"[faults] injected kill during canary watch round {rnd}",
+              flush=True)
+        os._exit(KILL_EXIT_CODE)
+
+    def replica_kill_due(self) -> bool:
+        """Called by the fleet at the start of each canary watch round.
+        True exactly once per process — the fleet then marks replica
+        ``kill_replica_nth - 1`` dead (soft kill, no exit).  No marker:
+        the kill re-fires on restart so every lineage sees the same
+        membership."""
+        if not self.spec.kill_replica_nth or self._replica_kill_fired:
+            return False
+        self._replica_kill_fired = True
+        print(f"[faults] soft-killing replica "
+              f"{self.spec.kill_replica_nth - 1} at canary watch", flush=True)
+        return True
 
     # --------------------------------------------------------------- io
 

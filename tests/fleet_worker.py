@@ -1,0 +1,120 @@
+"""Worker for the fleet/canary-gatekeeper drills (run as a subprocess,
+NOT pytest).
+
+Usage:
+    python fleet_worker.py <spec_json_path>
+
+Spec keys: ``data_dir``, ``checkpoint_dir``, ``log_dir``, ``request_log``
+(a fleet layout — ``replica-<k>`` subdirectories), ``out_json``,
+``local_devices``, ``steps_per_cycle``, ``max_cycles``, ``replicas``,
+``canary_cycles``, ``canary_fraction``, ``max_auc_regression``,
+``shadow_eval_batches``, ``keep_versions``, ``keep_consumed_segments``,
+``faults`` (a ``[faults]`` dict — regress_auc_at_cycle /
+kill_during_canary / kill_replica_nth / corrupt_candidate /
+kill_between_stages / kill_during_swap), ``probe_seed``.
+
+Spoofs CPU devices and runs the REAL gated ``OnlineLoop``
+(``train/online.py`` with ``[online] canary_cycles > 0``) over a
+``ServingFleet`` of ``[serving] replicas`` frontends sharing one
+``BundleStore``.  On completion it scores a deterministic probe trace
+through EVERY alive replica's live micro-batcher and writes the verdict to
+``out_json``: final store version + digest, canary/rejection ledgers, the
+merged replay cursor, per-replica served logits and per-replica served
+versions.  Injected hard kills exit via ``os._exit(KILL_EXIT_CODE)`` and
+write nothing; restarting the SAME spec must converge bitwise
+(tests/test_fleet.py asserts it).
+"""
+
+import json
+import sys
+from pathlib import Path
+
+
+def main() -> None:
+    spec = json.loads(Path(sys.argv[1]).read_text())
+
+    from tdfo_tpu.core.mesh import spoof_cpu_devices
+
+    spoof_cpu_devices(int(spec.get("local_devices", 8)))
+
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+
+    import numpy as np
+
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.serve.export import read_raw_bundle
+    from tdfo_tpu.serve.frontend import _column_vocab
+    from tdfo_tpu.train.online import OnlineLoop
+    from tdfo_tpu.train.trainer import _ctr_columns
+
+    cfg = read_configs(
+        None,
+        data_dir=spec["data_dir"],
+        model="twotower",
+        model_parallel=True,
+        n_epochs=1,
+        learning_rate=3e-3,
+        embed_dim=8,
+        per_device_train_batch_size=8,
+        per_device_eval_batch_size=8,
+        shuffle_buffer_size=500,
+        log_every_n_steps=1000,
+        size_map=load_size_map(spec["data_dir"]),
+        checkpoint_dir=spec["checkpoint_dir"],
+        faults=dict(spec.get("faults") or {}),
+        serving=dict(
+            replicas=int(spec.get("replicas", 2)),
+            keep_versions=int(spec.get("keep_versions", 0)),
+        ),
+        online=dict(
+            request_log=spec["request_log"],
+            steps_per_cycle=int(spec.get("steps_per_cycle", 2)),
+            max_cycles=int(spec.get("max_cycles", 0)),
+            canary_cycles=int(spec.get("canary_cycles", 1)),
+            canary_fraction=float(spec.get("canary_fraction", 0.5)),
+            max_auc_regression=float(spec.get("max_auc_regression", 0.3)),
+            shadow_eval_batches=int(spec.get("shadow_eval_batches", 1)),
+            keep_consumed_segments=int(
+                spec.get("keep_consumed_segments", 0)),
+        ),
+    )
+    loop = OnlineLoop(cfg, log_dir=spec["log_dir"])
+    stats = loop.run()
+
+    # deterministic probe trace through EVERY alive replica's live batcher:
+    # the per-replica served-logits fingerprint the fleet-convergence and
+    # bitwise-rollback acceptance compares
+    cat_cols, cont_cols = _ctr_columns(cfg)
+    vocab = _column_vocab(cfg, cat_cols)
+    rng = np.random.default_rng(int(spec.get("probe_seed", 606)))
+    requests = []
+    for i, n in enumerate((3, 5, 2, 8)):
+        batch = {c: rng.integers(0, vocab[c], size=n, dtype=np.int32)
+                 for c in cat_cols}
+        for c in cont_cols:
+            batch[c] = rng.random(n, dtype=np.float32)
+        requests.append((f"probe{i}", batch))
+    per_replica = loop.fleet.probe_each(requests)
+
+    manifest, _ = read_raw_bundle(loop.store.current_dir())
+    Path(spec["out_json"]).write_text(json.dumps({
+        "stats": stats,
+        "version": int(loop.store.current_version()),
+        "digest": manifest["digest"],
+        "canary_version": loop.store.canary_version(),
+        "rejections": loop.store.rejections(),
+        "cursor": loop.consumer.cursor(),
+        "cycles_done": int(loop.cycles_done),
+        "replica_versions": {str(k): v
+                             for k, v in loop.fleet.versions().items()},
+        "dead_replicas": sorted(loop.fleet._dead),
+        "logits": {str(rid): {q: np.asarray(v).tolist()
+                              for q, v in res.items()}
+                   for rid, res in per_replica.items()},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -594,3 +594,76 @@ def test_replay_fault_triggers_table(tmp_path: Path):
     assert cfg.faults.kill_during_replay == 4
     assert cfg.faults.kill_between_stages == 5
     assert cfg.faults.any()
+
+
+def test_fleet_and_gate_knobs(tmp_path: Path):
+    """PR-14 knobs: [serving] replicas/keep_versions and the [online]
+    canary-gatekeeper table — defaults, toml round-trip, and the
+    validation couplings (a gate needs a fleet to stage on, and a watch
+    window needs last-good + candidate co-resident on disk)."""
+    from tdfo_tpu.core.config import OnlineSpec, ServingSpec
+
+    cfg = read_configs()
+    assert cfg.serving.replicas == 1  # single frontend: the PR-9/10 path
+    assert cfg.serving.keep_versions == 0  # keep everything
+    assert cfg.online.canary_cycles == 0  # ungated publish
+    assert cfg.online.canary_fraction == 0.25
+    assert cfg.online.max_auc_regression == 0.02
+    assert cfg.online.shadow_eval_batches == 1
+    assert cfg.online.keep_consumed_segments == 0
+
+    (tmp_path / "config.toml").write_text(
+        "checkpoint_dir = \"ckpt\"\n"
+        "[serving]\nreplicas = 4\nkeep_versions = 3\n"
+        "[online]\nrequest_log = \"rl\"\ncanary_cycles = 2\n"
+        "canary_fraction = 0.5\nmax_auc_regression = 0.05\n"
+        "shadow_eval_batches = 2\nkeep_consumed_segments = 4\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.serving.replicas == 4
+    assert cfg.serving.keep_versions == 3
+    assert cfg.online.canary_cycles == 2
+    assert cfg.online.canary_fraction == 0.5
+    assert cfg.online.max_auc_regression == 0.05
+    assert cfg.online.shadow_eval_batches == 2
+    assert cfg.online.keep_consumed_segments == 4
+
+    for kw, match in (
+        (dict(serving=ServingSpec(replicas=0)), "replicas"),
+        (dict(serving=ServingSpec(keep_versions=-1)), "keep_versions"),
+        (dict(online=OnlineSpec(canary_cycles=-1)), "canary_cycles"),
+        (dict(online=OnlineSpec(canary_fraction=0.0)), "canary_fraction"),
+        (dict(online=OnlineSpec(canary_fraction=1.0)), "canary_fraction"),
+        (dict(online=OnlineSpec(max_auc_regression=-0.1)),
+         "max_auc_regression"),
+        (dict(online=OnlineSpec(shadow_eval_batches=0)),
+         "shadow_eval_batches"),
+        (dict(online=OnlineSpec(keep_consumed_segments=-1)),
+         "keep_consumed_segments"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(**kw)
+    # the gate stages candidates on a canary SLICE of the fleet: a single
+    # frontend has no stable cohort to compare against
+    with pytest.raises(ValueError, match="replicas >= 2"):
+        Config(online=OnlineSpec(canary_cycles=1))
+    # keep_versions = 1 cannot hold last-good + candidate simultaneously
+    with pytest.raises(ValueError, match="keep_versions"):
+        Config(online=OnlineSpec(canary_cycles=1),
+               serving=ServingSpec(replicas=2, keep_versions=1))
+    Config(online=OnlineSpec(canary_cycles=1),
+           serving=ServingSpec(replicas=2, keep_versions=2))
+    Config(online=OnlineSpec(canary_cycles=1),
+           serving=ServingSpec(replicas=2))  # unbounded retention is fine
+
+
+def test_fleet_fault_triggers_table(tmp_path: Path):
+    """The PR-14 [faults] triggers round-trip and arm the injector."""
+    (tmp_path / "config.toml").write_text(
+        "[faults]\ncorrupt_candidate = 1\nregress_auc_at_cycle = 2\n"
+        "kill_during_canary = 3\nkill_replica_nth = 4\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.faults.corrupt_candidate == 1
+    assert cfg.faults.regress_auc_at_cycle == 2
+    assert cfg.faults.kill_during_canary == 3
+    assert cfg.faults.kill_replica_nth == 4
+    assert cfg.faults.any()

@@ -1,0 +1,379 @@
+"""Fleet/canary-gatekeeper acceptance: a multi-replica serving fleet over
+one ``BundleStore``, a gated online supervisor (``[online] canary_cycles``)
+that shadow-scores every candidate, canaries it on a fraction of replicas
+and auto-rolls-back on AUC regression — drilled with REAL deterministic
+faults (``regress_auc_at_cycle`` training/serving skew, ``os._exit`` kills
+mid-canary) in subprocesses, the tests/test_online.py pattern.
+
+The request logs are written ONCE by the module fixture as a FLEET layout
+(``replica-<k>`` per-replica directories, the ``serve/fleet.py`` writer
+contract) with labels correlated with the ``avg_rating`` feature, so the
+injected skew (negated ``avg_rating``) measurably craters held-out AUC
+while honest scorers do not.
+
+Tier 1 runs the acceptance drill: ``regress_auc_at_cycle=1`` passes the
+shadow gate (the bundle BYTES are healthy), reaches only the canary
+cohort, rolls back bitwise with the rejection ledgered — plus the same
+drill killed mid-canary-watch and restarted, which must converge to the
+uninterrupted drill verdict bit for bit.  The wider supervisor/replica
+kill matrix is ``@pytest.mark.slow``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = str(Path(__file__).resolve().parents[1])
+WORKER = str(Path(__file__).with_name("fleet_worker.py"))
+
+LOCAL_DEVICES = 4
+BATCH_ROWS = 8 * 4  # per_device_train_batch_size x data-axis size
+STEPS_PER_CYCLE = 2
+N_CYCLES = 2  # full gated cycles the fleet logs hold
+N_REPLICAS = 2  # canary_fraction 0.5 -> replica 0 canaries, replica 1 stable
+
+
+def _spawn(spec_path: Path) -> subprocess.Popen:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = f"{REPO}{os.pathsep}" + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(spec_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+    )
+
+
+def _run_workers(spec_paths: list[Path]) -> tuple[list[int], list[str]]:
+    procs = [_spawn(p) for p in spec_paths]
+    rcs, outs = [], []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            rcs.append(p.returncode)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    return rcs, outs
+
+
+def _run_worker(spec_path: Path) -> tuple[int, str]:
+    rcs, outs = _run_workers([spec_path])
+    return rcs[0], outs[0]
+
+
+@pytest.fixture(scope="module")
+def fleet_env(tmp_path_factory):
+    """Synthetic goodreads data + a per-replica fleet request-log layout.
+
+    Labels are a deterministic function of the first continuous column
+    (``label = avg_rating > 0.5``): honest scorers sit near (or above)
+    chance on the held-out slice, while the injected skew — which serves
+    ``-avg_rating`` — scores near-zero AUC, so the canary watch separates
+    them by a margin far beyond ``max_auc_regression``."""
+    from tdfo_tpu.core.config import load_size_map, read_configs
+    from tdfo_tpu.data.ctr_preprocessing import run_ctr_preprocessing
+    from tdfo_tpu.data.replay import RequestLog, replica_log_dir
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.serve.frontend import _column_vocab
+    from tdfo_tpu.train.trainer import _ctr_columns
+
+    d = tmp_path_factory.mktemp("gr_fleet")
+    write_synthetic_goodreads(d, n_users=80, n_books=120,
+                              interactions_per_user=(15, 40), seed=29)
+    run_ctr_preprocessing(d)
+
+    cfg = read_configs(None, data_dir=str(d), model="twotower",
+                       model_parallel=True, size_map=load_size_map(str(d)))
+    cat_cols, cont_cols = _ctr_columns(cfg)
+    vocab = _column_vocab(cfg, cat_cols)
+
+    root = tmp_path_factory.mktemp("fleetlog") / "rl"
+    logs = [RequestLog(replica_log_dir(root, k), segment_bytes=4096)
+            for k in range(N_REPLICAS)]
+    rng = np.random.default_rng(11)
+    # every gated cycle consumes steps_per_cycle train batches AND peeks one
+    # shadow batch beyond them, so the log needs one extra batch of slack
+    rows_by_key: dict[tuple[int, int], int] = {}
+    total, target = 0, (N_CYCLES * STEPS_PER_CYCLE + 1) * BATCH_ROWS
+    i = 0
+    while total < target + 5:  # sub-batch tail stays unread
+        n = int(rng.integers(3, 9))
+        feats = {c: rng.integers(0, vocab[c], size=n).tolist()
+                 for c in cat_cols}
+        for c in cont_cols:
+            feats[c] = [round(float(v), 6) for v in rng.random(n)]
+        feats["label"] = [int(v > 0.5) for v in feats[cont_cols[0]]]
+        rid = i % N_REPLICAS  # interleave traffic across the fleet
+        seq = logs[rid].append({
+            "event": "serve_request", "request": f"r{total}", "rows": n,
+            "outcome": "ok", "features": feats})
+        rows_by_key[(rid, seq)] = n
+        total += n
+        i += 1
+    for log in logs:
+        log.close()
+    return dict(data_dir=str(d), request_log=str(root),
+                rows_by_key=rows_by_key, total_rows=total)
+
+
+def _make_spec(tmp: Path, env: dict, name: str, *, ckpt: str, log: str,
+               faults: dict | None = None, **knobs) -> Path:
+    spec = dict(
+        data_dir=env["data_dir"], checkpoint_dir=str(tmp / ckpt),
+        log_dir=str(tmp / log), request_log=env["request_log"],
+        out_json=str(tmp / f"{name}.json"), local_devices=LOCAL_DEVICES,
+        steps_per_cycle=STEPS_PER_CYCLE, max_cycles=0,
+        replicas=N_REPLICAS, canary_cycles=1, canary_fraction=0.5,
+        max_auc_regression=0.3, shadow_eval_batches=1,
+        faults=faults or {}, **knobs,
+    )
+    p = tmp / f"{name}_spec.json"
+    p.write_text(json.dumps(spec))
+    return p
+
+
+@pytest.fixture(scope="module")
+def fleet_runs(fleet_env, tmp_path_factory):
+    """The tier-1 acceptance drill, run once for every audit below:
+
+      * ``drill`` — ``regress_auc_at_cycle=1``: cycle 1's candidate serves
+        skewed logits, must auto-rollback; cycle 2 retrains and promotes.
+      * ``killdrill`` — the same regression PLUS ``kill_during_canary=1``:
+        dies mid-watch with the candidate on the canary cohort and no
+        durable verdict, then restarts the same command.
+    """
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    tmp = tmp_path_factory.mktemp("fleet_runs")
+    drill_p = _make_spec(tmp, fleet_env, "drill", ckpt="ckpt_drill",
+                         log="log_drill",
+                         faults={"regress_auc_at_cycle": 1})
+    kill_p = _make_spec(tmp, fleet_env, "killdrill", ckpt="ckpt_kill",
+                        log="log_kill",
+                        faults={"regress_auc_at_cycle": 1,
+                                "kill_during_canary": 1})
+
+    rcs, outs = _run_workers([drill_p, kill_p])
+    assert rcs[0] == 0, f"drill run failed rc={rcs[0]}\n{outs[0][-2000:]}"
+    assert rcs[1] == KILL_EXIT_CODE, \
+        f"expected mid-canary kill, got rc={rcs[1]}\n{outs[1][-2000:]}"
+    assert not (tmp / "killdrill.json").exists()  # died before any verdict
+    assert (tmp / "ckpt_kill" / "faults_canary_kill.marker").exists()
+
+    rc, out = _run_worker(kill_p)  # marker disarms the kill; redo the cycle
+    assert rc == 0, f"resumed killdrill failed rc={rc}\n{out[-2000:]}"
+
+    return dict(
+        drill=json.loads((tmp / "drill.json").read_text()),
+        killdrill=json.loads((tmp / "killdrill.json").read_text()),
+        drill_metrics=tmp / "log_drill" / "metrics.jsonl",
+        tmp=tmp,
+    )
+
+
+def _events(path: Path, event: str) -> list[dict]:
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    return [r for r in recs if r.get("event") == event]
+
+
+def test_drill_shadow_passes_then_canary_rolls_back(fleet_runs):
+    """The acceptance fault drill: the regressing bundle's BYTES are
+    healthy, so it passes the shadow gate and reaches the canary cohort —
+    where held-out heartbeats catch the skew and roll it back, with the
+    rejection recorded in cycle metrics and the store ledger."""
+    cycles = _events(fleet_runs["drill_metrics"], "online_cycle")
+    assert [c["verdict"] for c in cycles] == ["rollback", "promote"]
+    bad = cycles[0]
+    assert bad["gated"] and bad["cycle"] == 1 and bad["version"] == 1
+    # shadow gate scored the candidate and passed it (bytes are honest)
+    assert bad["shadow_auc"] >= bad["shadow_auc_base"] - 0.3
+    # the canary watch measured the skew: near-zero AUC vs an honest stable
+    assert bad["canary_auc"] < bad["stable_auc"] - 0.3
+    assert "canary AUC" in bad["reason"]
+    # the rejection is ledgered durably, keyed (version, digest)
+    rej = fleet_runs["drill"]["rejections"]
+    assert len(rej) == 1 and rej[0]["version"] == 1
+    assert rej[0]["digest"] != fleet_runs["drill"]["digest"]
+    # cycle 2 REUSES version 1 (delta chain stays parent+1) and promotes
+    good = cycles[1]
+    assert good["version"] == 1 and fleet_runs["drill"]["version"] == 1
+    assert fleet_runs["drill"]["canary_version"] is None
+
+
+def test_drill_canary_containment(fleet_runs):
+    """While the bad candidate was live it served AT MOST the canary
+    fraction of the fleet: watch-round heartbeats show the canary replica
+    on the candidate and every stable replica still on the last good
+    version."""
+    hbs = _events(fleet_runs["drill_metrics"], "canary_heartbeat")
+    round1 = [h for h in hbs if h["cycle"] == 1]
+    assert {h["replica"] for h in round1} == set(range(N_REPLICAS))
+    for h in round1:
+        if h["canary"]:
+            assert h["version"] == 1  # the candidate, canary cohort only
+        else:
+            assert h["version"] == 0  # stable stayed on the last good head
+
+
+def test_drill_fleet_converges_bitwise(fleet_runs):
+    """After the rollback + the healthy promote, every replica serves the
+    same version and bitwise-identical probe logits — no replica is left
+    on the rejected bundle."""
+    drill = fleet_runs["drill"]
+    versions = set(drill["replica_versions"].values())
+    assert versions == {drill["version"]}
+    logits = list(drill["logits"].values())
+    assert len(logits) == N_REPLICAS
+    for other in logits[1:]:
+        assert other == logits[0]
+
+
+def test_kill_during_canary_restart_converges(fleet_runs):
+    """A kill mid-canary-watch (candidate live on the cohort, no durable
+    verdict) + restart must converge to the uninterrupted drill's exact
+    fleet state: store version AND digest, rejection ledger, merged replay
+    cursor, per-replica served logits."""
+    drill, kd = fleet_runs["drill"], fleet_runs["killdrill"]
+    assert kd["version"] == drill["version"]
+    assert kd["digest"] == drill["digest"]
+    assert kd["cursor"] == drill["cursor"]
+    assert kd["cycles_done"] == drill["cycles_done"]
+    assert kd["logits"] == drill["logits"]
+    assert [(r["version"], r["digest"]) for r in kd["rejections"]] == \
+        [(r["version"], r["digest"]) for r in drill["rejections"]]
+
+
+def test_merged_replay_exactly_once_accounting(fleet_runs, fleet_env):
+    """Across the drill's durable cycles the consumed ``(replica_id, seq,
+    row_start, row_end)`` spans tile each fleet record at most once with
+    no gap and no overlap — replica interleave does not break the
+    exactly-once contract, and rejected cycles still account their
+    consumed-but-discarded records."""
+    cycles = _events(fleet_runs["drill_metrics"], "online_cycle")
+    assert len(cycles) == N_CYCLES
+    spans: dict[tuple[int, int], list[tuple[int, int]]] = {}
+    for c in cycles:
+        for rid, seq, a, b in c["consumed"]:
+            spans.setdefault((rid, seq), []).append((a, b))
+    rows_by_key = {tuple(map(int, k)) if isinstance(k, tuple) else k: v
+                   for k, v in fleet_env["rows_by_key"].items()}
+    assert spans, "no consumed spans logged"
+    for key, parts in spans.items():
+        parts.sort()
+        assert parts[0][0] == 0, (key, parts)
+        for (a0, b0), (a1, b1) in zip(parts, parts[1:]):
+            assert b0 == a1, f"{key}: gap or overlap at {parts}"
+        assert parts[-1][1] <= rows_by_key[key]
+    # both replicas' logs contributed to training — the merger merges
+    assert {k[0] for k in spans} == set(range(N_REPLICAS))
+
+
+# --------------------------------------------------------------------------
+# the wider kill matrix: supervisor kills at gated stage boundaries and
+# replica deaths mid-watch.  Tier 1 covers the mid-canary kill above.
+
+
+@pytest.fixture(scope="module")
+def healthy_ref(fleet_env, tmp_path_factory):
+    """Uninterrupted fault-free gated run — the slow matrix's reference."""
+    tmp = tmp_path_factory.mktemp("fleet_ref")
+    spec = _make_spec(tmp, fleet_env, "ref", ckpt="ckpt_ref", log="log_ref")
+    rc, out = _run_worker(spec)
+    assert rc == 0, f"reference run failed rc={rc}\n{out[-2000:]}"
+    ref = json.loads((tmp / "ref.json").read_text())
+    ref["_metrics"] = str(tmp / "log_ref" / "metrics.jsonl")
+    return ref
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("faults", [
+    {"kill_between_stages": 6},  # canary watched, verdict not yet durable
+    {"kill_between_stages": 7},  # verdict durable, store commit missing
+    {"kill_between_stages": 8},  # committed, fleet re-sync + GC missing
+    {"kill_during_swap": 1},     # mid-publish_canary: torn canary dir
+    {"corrupt_candidate": 1},    # gate catches the bit-flip, re-export heals
+], ids=lambda f: "-".join(f"{k}{v}" for k, v in f.items()))
+def test_gated_kill_matrix_converges(healthy_ref, fleet_env, tmp_path,
+                                     faults):
+    """Kill the gated supervisor at every post-publish stage boundary (and
+    corrupt a candidate export): restarting the same command must converge
+    to the fault-free reference, bit for bit."""
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    spec = _make_spec(tmp_path, fleet_env, "killed", ckpt="ckpt",
+                      log="log", faults=faults)
+    rc, out = _run_worker(spec)
+    if "corrupt_candidate" in faults:
+        assert rc == 0, f"rc={rc}\n{out[-2000:]}"  # healed in-line, no kill
+    else:
+        assert rc == KILL_EXIT_CODE, f"rc={rc}\n{out[-2000:]}"
+        assert not (tmp_path / "killed.json").exists()
+        rc, out = _run_worker(spec)
+        assert rc == 0, f"resumed run failed rc={rc}\n{out[-2000:]}"
+    resumed = json.loads((tmp_path / "killed.json").read_text())
+    assert resumed["version"] == healthy_ref["version"]
+    assert resumed["digest"] == healthy_ref["digest"]
+    assert resumed["cursor"] == healthy_ref["cursor"]
+    assert resumed["logits"] == healthy_ref["logits"]
+    assert resumed["rejections"] == []
+
+
+@pytest.mark.slow
+def test_drill_kill_before_commit_converges(fleet_runs, fleet_env, tmp_path):
+    """The rollback twin of the promote catch-up: die AFTER the rollback
+    verdict is durable but BEFORE the store rollback executes —
+    ``_catch_up_gated`` must replay the recorded verdict on restart and
+    converge to the uninterrupted drill."""
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    spec = _make_spec(tmp_path, fleet_env, "killed", ckpt="ckpt", log="log",
+                      faults={"regress_auc_at_cycle": 1,
+                              "kill_between_stages": 7})
+    rc, out = _run_worker(spec)
+    assert rc == KILL_EXIT_CODE, f"rc={rc}\n{out[-2000:]}"
+    rc, out = _run_worker(spec)
+    assert rc == 0, f"resumed run failed rc={rc}\n{out[-2000:]}"
+    resumed = json.loads((tmp_path / "killed.json").read_text())
+    drill = fleet_runs["drill"]
+    assert resumed["version"] == drill["version"]
+    assert resumed["digest"] == drill["digest"]
+    assert resumed["cursor"] == drill["cursor"]
+    assert resumed["logits"] == drill["logits"]
+    assert [(r["version"], r["digest"]) for r in resumed["rejections"]] == \
+        [(r["version"], r["digest"]) for r in drill["rejections"]]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("nth,expect", [
+    (1, "rollback"),  # the only canary replica dies: no signal -> rollback
+    (2, "promote"),   # a stable replica dies: stable AUC falls back to the
+                      # shadow baseline and the healthy candidate promotes
+], ids=["kill-canary-replica", "kill-stable-replica"])
+def test_replica_death_mid_watch(fleet_env, tmp_path, nth, expect):
+    """Replica death during the watch: losing the canary cohort forces a
+    conservative rollback (no signal is not good signal); losing a stable
+    replica must NOT block promotion of a healthy candidate."""
+    spec = _make_spec(tmp_path, fleet_env, "rk", ckpt="ckpt", log="log",
+                      faults={"kill_replica_nth": nth})
+    rc, out = _run_worker(spec)
+    assert rc == 0, f"rc={rc}\n{out[-2000:]}"
+    res = json.loads((tmp_path / "rk.json").read_text())
+    assert res["dead_replicas"] == [nth - 1]
+    cycles = _events(tmp_path / "log" / "metrics.jsonl", "online_cycle")
+    assert cycles and all(c["verdict"] == expect for c in cycles)
+    if expect == "rollback":
+        assert res["version"] == 0  # nothing ever promoted
+        assert all(c["reason"] == "no alive canary replica" for c in cycles)
+    else:
+        assert res["version"] == N_CYCLES
+        assert res["rejections"] == []
+    # the dead replica serves nothing; survivors converge on the head
+    assert str(nth - 1) not in res["replica_versions"]
+    assert set(res["replica_versions"].values()) == {res["version"]}

@@ -530,3 +530,137 @@ def test_no_adhoc_jsonl_tailers():
         "data/replay.py — it bypasses torn-tail recovery, seal digests and "
         "the exactly-once cursor; read through ReplayConsumer: "
         + ", ".join(offenders))
+
+
+def test_no_pointer_writes_outside_swap_store_helpers():
+    """The ``CURRENT``/``CANARY`` pointers are the serving fleet's single
+    source of truth: every replica follows them, the canary state machine's
+    crash windows are proven ONLY for the write orderings inside
+    ``serve/swap.py`` (pointer-first canary publish, CURRENT-first
+    promotion — see its docstring).  An ``atomic_write_json`` whose
+    argument names either pointer anywhere else is an unvetted state
+    machine transition: it can regress CURRENT past a verdict or publish
+    an unvetted canary.  Sanctioned writers: ``_publish``, ``recover``,
+    ``publish_canary``, ``promote_canary``, ``rollback_canary`` in
+    serve/swap.py.  Self-tested on a synthetic offender."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    SANCTIONED_FILE = "serve/swap.py"
+    SANCTIONED_FUNCS = {"_publish", "recover", "publish_canary",
+                        "promote_canary", "rollback_canary"}
+
+    def names_pointer(node):
+        # the module constants _CURRENT/_CANARY, or their literal values
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name) and n.id in ("_CURRENT", "_CANARY"):
+                return True
+            if isinstance(n, ast.Constant) and n.value in ("CURRENT",
+                                                           "CANARY"):
+                return True
+        return False
+
+    def pointer_write_lines(tree):
+        parents = {}
+        for node in ast.walk(tree):
+            for ch in ast.iter_child_nodes(node):
+                parents[ch] = node
+
+        def enclosing_funcs(node):
+            out = []
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.append(node.name)
+            return out
+
+        hits = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_writer = (isinstance(f, ast.Name)
+                         and f.id == "atomic_write_json") or (
+                isinstance(f, ast.Attribute)
+                and f.attr == "atomic_write_json")
+            if not is_writer:
+                continue
+            operands = list(node.args) + [k.value for k in node.keywords]
+            if any(names_pointer(a) for a in operands):
+                hits.append((node.lineno, enclosing_funcs(node)))
+        return hits
+
+    synthetic = (
+        "from tdfo_tpu.serve.swap import atomic_write_json\n"
+        "def hijack(store, v):\n"
+        "    atomic_write_json(store.root / 'CURRENT', {'version': v})\n")
+    syn = pointer_write_lines(ast.parse(synthetic))
+    assert [(ln, fns) for ln, fns in syn] == [(3, ["hijack"])]
+
+    offenders, sanctioned_hits = [], 0
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        for ln, fns in pointer_write_lines(
+                ast.parse(path.read_text(), filename=str(path))):
+            if rel == SANCTIONED_FILE and SANCTIONED_FUNCS & set(fns):
+                sanctioned_hits += 1
+                continue
+            offenders.append(f"{path}:{ln}")
+    assert sanctioned_hits >= 3  # _publish + publish_canary + promote/recover
+    assert not offenders, (
+        "CURRENT/CANARY pointer write outside serve/swap.py's blessed "
+        "helpers (unvetted canary state machine transition — route through "
+        "publish_canary/promote_canary/rollback_canary): "
+        + ", ".join(offenders))
+
+
+def test_no_hard_exits_outside_fault_injector():
+    """``os._exit`` skips every durability mechanism this repo builds on —
+    atexit hooks, finally blocks, buffered writes.  That is exactly what
+    the fault injector WANTS (a real preemption gives no notice, so the
+    kill triggers in ``utils/faults.py`` must model it faithfully) and
+    exactly what production code must never do: a convenience hard-exit in
+    a serving or training path would turn an error into silent data loss
+    that the kill/restart tests cannot see.  Self-tested on a synthetic
+    offender."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+
+    def hard_exit_lines(tree):
+        hits = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "_exit"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"):
+                hits.append(node.lineno)
+        return hits
+
+    synthetic = (
+        "import os\n"
+        "def bail():\n"
+        "    os._exit(1)\n")
+    assert hard_exit_lines(ast.parse(synthetic)) == [3]
+
+    offenders, sanctioned_hits = [], 0
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        lines = hard_exit_lines(ast.parse(path.read_text(),
+                                          filename=str(path)))
+        if rel == "utils/faults.py":
+            sanctioned_hits += len(lines)
+            continue
+        offenders += [f"{path}:{ln}" for ln in lines]
+    assert sanctioned_hits > 0  # the scanner sees the kill triggers
+    assert not offenders, (
+        "os._exit outside utils/faults.py (skips atexit/finally/buffers — "
+        "raise, or route deterministic kills through the fault injector): "
+        + ", ".join(offenders))

@@ -22,10 +22,13 @@ import pytest
 
 from tdfo_tpu.data.replay import (
     REPLAY_SCHEMA_VERSION,
+    MergedReplayConsumer,
     ReplayConsumer,
     ReplayError,
     ReplayLagError,
     RequestLog,
+    make_replay_consumer,
+    replica_log_dir,
 )
 from tdfo_tpu.utils import faults
 from tdfo_tpu.utils.faults import FaultSpec
@@ -355,6 +358,214 @@ def test_backpressure_within_bound_is_noop(tmp_path):
                        max_lag_records=8, lag_policy="fail")
     assert c.check_backpressure() == 2
     assert c.cursor()["skipped"] == 0
+
+
+# ------------------------------------------------- shadow peek + retention
+
+
+def test_peek_batches_commits_nothing(tmp_path):
+    log = _write(tmp_path / "rl", n_records=6, rows=3)
+    log.close()
+    c = ReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    before = c.cursor()
+    peeked = c.peek_batches(2)
+    assert [b["x"].tolist() for b in peeked] == [[0, 1, 2, 3, 4, 5],
+                                                [6, 7, 8, 9, 10, 11]]
+    assert c.cursor() == before  # the shadow slice moved NOTHING
+    # the very same rows then train normally — progressive validation
+    batch, _ = c.next_batch()
+    assert batch["x"].tolist() == peeked[0]["x"].tolist()
+
+
+def test_peek_batches_short_log_returns_partial(tmp_path):
+    log = _write(tmp_path / "rl", n_records=2, rows=3)
+    log.close()
+    c = ReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    before = c.cursor()
+    assert len(c.peek_batches(3)) == 1  # only one full batch exists
+    assert c.cursor() == before
+
+
+def test_gc_consumed_segments_deletes_only_behind_cursor(tmp_path):
+    root = tmp_path / "rl"
+    log = _write(root, n_records=12, rows=3, segment_bytes=256)
+    n_segs = log.active_segment + 1
+    assert n_segs >= 3
+    log.close()
+    c = ReplayConsumer(root, schema=SCHEMA, batch_size=6)
+    _drain_x(c)
+    final = c.cursor()["segment"]
+    deleted = c.gc_consumed_segments(keep=1)
+    assert deleted == list(range(final - 1))  # newest consumed one kept
+    for i in deleted:
+        assert not (root / f"requests-{i:06d}.jsonl").exists()
+        assert not (root / f"requests-{i:06d}.seal.json").exists()
+    # idempotent: nothing left below the retention line
+    assert c.gc_consumed_segments(keep=1) == []
+    # the survivors still replay from a persisted cursor (restart shape)
+    c2 = ReplayConsumer(root, schema=SCHEMA, batch_size=6, cursor=c.cursor())
+    assert c2.next_batch() is None  # fully drained, no refusal
+
+
+def test_gc_refuses_candidate_segment(tmp_path):
+    root = tmp_path / "rl"
+    log = _write(root, n_records=12, rows=3, segment_bytes=256)
+    log.close()
+    c = ReplayConsumer(root, schema=SCHEMA, batch_size=6)
+    batch, _ = c.next_batch()  # cursor still inside segment 0
+    with pytest.raises(ValueError, match="cursor still points into"):
+        c.gc_segments(c.cursor()["segment"])
+    assert c.gc_consumed_segments() == []  # nothing strictly behind yet
+    assert (root / "requests-000000.jsonl").exists()
+
+
+def test_gc_refuses_missing_seal_below_cursor(tmp_path):
+    root = tmp_path / "rl"
+    log = _write(root, n_records=12, rows=3, segment_bytes=256)
+    log.close()
+    c = ReplayConsumer(root, schema=SCHEMA, batch_size=6)
+    _drain_x(c)
+    os.unlink(sorted(root.glob("*.seal.json"))[0])
+    with pytest.raises(ValueError, match="no seal sidecar"):
+        c.gc_consumed_segments()
+
+
+# -------------------------------------------------------------- fleet merge
+
+
+def _write_fleet(root: Path, n_records: int = 6, rows: int = 3,
+                 segment_bytes: int = 0) -> None:
+    """Two replica logs with disjoint row ids: replica 0 counts from 0,
+    replica 1 from 1000 — so provenance survives into the drained rows."""
+    for rid, base in ((0, 0), (1, 1000)):
+        log = RequestLog(replica_log_dir(root, rid),
+                         segment_bytes=segment_bytes)
+        for i in range(n_records):
+            log.append(_record(rows, x0=base + i * rows))
+        log.close()
+
+
+def _drain_merged(c: MergedReplayConsumer) -> tuple[list[int], list[tuple]]:
+    xs, spans = [], []
+    while True:
+        out = c.next_batch()
+        if out is None:
+            return xs, spans
+        batch, consumed = out
+        assert consumed and all(b > a for _, _, a, b in consumed)
+        spans += [tuple(s) for s in consumed]
+        xs += batch["x"].tolist()
+
+
+def test_merged_round_robin_exactly_once(tmp_path):
+    _write_fleet(tmp_path / "rl", n_records=4, rows=3)
+    c = make_replay_consumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    assert isinstance(c, MergedReplayConsumer)
+    xs, spans = _drain_merged(c)
+    # record-level round-robin: r0's record, then r1's, alternating
+    assert xs == [0, 1, 2, 1000, 1001, 1002, 3, 4, 5, 1003, 1004, 1005,
+                  6, 7, 8, 1006, 1007, 1008, 9, 10, 11, 1009, 1010, 1011]
+    # every (replica, seq) span tiles its record exactly once
+    assert sorted(spans) == [(rid, seq, 0, 3)
+                             for rid in (0, 1) for seq in (1, 2, 3, 4)]
+    cur = c.cursor()
+    assert set(cur) == {"rr", "replicas"}
+    assert set(cur["replicas"]) == {"0", "1"}
+    assert c.counters()["replay/records"] == 8.0
+
+
+def test_merged_mid_record_cursor_resume(tmp_path):
+    """A merged cursor persisted at a batch boundary that splits a record
+    resumes at the exact row on the exact replica."""
+    _write_fleet(tmp_path / "rl", n_records=3, rows=5)
+    c1 = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=4)
+    first, _ = c1.next_batch()  # splits replica 0's first record
+    saved = json.loads(json.dumps(c1.cursor()))  # checkpoint round-trip
+    c2 = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=4,
+                              cursor=saved)
+    resumed, _ = c2.next_batch()
+    fresh = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA,
+                                 batch_size=4)
+    ref1, _ = fresh.next_batch()
+    ref2, _ = fresh.next_batch()
+    assert first["x"].tolist() == ref1["x"].tolist()
+    assert resumed["x"].tolist() == ref2["x"].tolist()  # no dup, no skip
+
+
+def test_merged_uncommitted_batch_leaves_subs_untouched(tmp_path):
+    """All-or-nothing across replicas: a short tail commits NO sub-cursor
+    even when one replica's rows were provisionally taken."""
+    root = tmp_path / "rl"
+    log0 = RequestLog(replica_log_dir(root, 0))
+    log0.append(_record(3, x0=0))
+    log0.close()
+    log1 = RequestLog(replica_log_dir(root, 1))
+    log1.append(_record(2, x0=1000))
+    log1.close()
+    c = MergedReplayConsumer(root, schema=SCHEMA, batch_size=8)
+    before = json.dumps(c.cursor(), sort_keys=True)
+    assert c.next_batch() is None  # 5 rows < batch_size
+    assert json.dumps(c.cursor(), sort_keys=True) == before
+
+
+def test_merged_peek_batches_commits_nothing(tmp_path):
+    _write_fleet(tmp_path / "rl", n_records=4, rows=3)
+    c = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    before = json.dumps(c.cursor(), sort_keys=True)
+    peeked = c.peek_batches(2)
+    assert len(peeked) == 2
+    assert json.dumps(c.cursor(), sort_keys=True) == before
+    batch, _ = c.next_batch()
+    assert batch["x"].tolist() == peeked[0]["x"].tolist()
+
+
+def test_merged_rejects_plain_cursor_and_vice_versa(tmp_path):
+    """Cursor-shape mismatches refuse LOUDLY in both directions — a fleet
+    resuming from a single-log checkpoint (or the reverse) is operator
+    error, not something to paper over."""
+    _write_fleet(tmp_path / "rl", n_records=2, rows=3)
+    plain = {"segment": 0, "offset": 0, "row": 0, "seq": 0, "records": 2}
+    with pytest.raises(ValueError, match="not a merged replay cursor"):
+        MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6,
+                             cursor=plain)
+    c = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    c.next_batch()
+    merged_cur = c.cursor()
+    with pytest.raises(ValueError, match="unknown replay cursor"):
+        ReplayConsumer(replica_log_dir(tmp_path / "rl", 0), schema=SCHEMA,
+                       batch_size=6, cursor=merged_cur)
+
+
+def test_merged_rejects_ghost_replica_cursor(tmp_path):
+    _write_fleet(tmp_path / "rl", n_records=2, rows=3)
+    c = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    cur = c.cursor()
+    cur["replicas"]["7"] = dict(cur["replicas"]["0"])
+    with pytest.raises(ValueError, match="no log directory"):
+        MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6,
+                             cursor=cur)
+
+
+def test_merged_requires_fleet_layout(tmp_path):
+    log = _write(tmp_path / "rl", n_records=2)
+    log.close()
+    with pytest.raises(ValueError, match="no replica"):
+        MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    # ... and the factory picks the flat consumer for the flat layout
+    assert isinstance(make_replay_consumer(tmp_path / "rl", schema=SCHEMA,
+                                           batch_size=6), ReplayConsumer)
+
+
+def test_merged_gc_consumed_segments(tmp_path):
+    _write_fleet(tmp_path / "rl", n_records=12, rows=3, segment_bytes=256)
+    c = MergedReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=6)
+    _drain_merged(c)
+    deleted = c.gc_consumed_segments()
+    assert deleted and {rid for rid, _ in deleted} == {0, 1}
+    for rid, seg in deleted:
+        assert not (replica_log_dir(tmp_path / "rl", rid)
+                    / f"requests-{seg:06d}.jsonl").exists()
+    assert c.gc_consumed_segments() == []
 
 
 # ----------------------------------------------------- frontend log wiring

@@ -489,6 +489,268 @@ def test_backoff_delay_cap_and_jitter():
         backoff_delay(-1)
 
 
+# ------------------------------------- canary pointer lifecycle + retention
+
+
+def _toy_delta(out, store, seed=0):
+    """A hand-built dense-kind delta extending the store's CURRENT: replaces
+    ``params:w`` whole (dense arrays ship whole, ``export.py`` contract)."""
+    base_m, base_a = read_raw_bundle(store.current_dir())
+    rng = np.random.default_rng(seed + 100 + base_m["version"])
+    new_w = rng.random((4, 4)).astype(np.float32)
+    out_m = {k: v for k, v in base_m.items() if k != "digest"}
+    out_m["version"] = base_m["version"] + 1
+    out_m["step"] = base_m["version"] + 1
+    result_digest = bundle_digest(out_m, {"params:w": new_w})
+    dm = {"bundle_version": 1, "kind": "delta", "base_kind": "dense",
+          "model": "twotower", "step": out_m["step"], "dtype": "float32",
+          "version": out_m["version"],
+          "parent_version": base_m["version"],
+          "parent_digest": base_m["digest"],
+          "result_digest": result_digest, "tables_delta": {},
+          "replaced": ["params:w"]}
+    da = {"params:w": new_w}
+    dm["digest"] = bundle_digest(dm, da)
+    return write_raw_bundle(out, dm, da)
+
+
+def _digest_of(path):
+    m, _ = read_raw_bundle(path)
+    return m["digest"]
+
+
+def test_canary_publish_promote_lifecycle(tmp_path):
+    """publish_canary leaves CURRENT untouched while CANARY names the
+    candidate; promote_canary advances CURRENT to the digest-verified
+    candidate and clears CANARY.  Both are idempotent redo targets."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    delta = _toy_delta(tmp_path / "d1", store)
+    assert store.publish_canary(delta) == 1
+    assert store.current_version() == 0  # the fleet majority is untouched
+    assert store.canary_version() == 1
+    assert (store.canary_dir() / "bundle.json").exists()
+    # redo (crashed supervisor re-runs the same publish): same outcome
+    assert store.publish_canary(delta) == 1
+    assert store.current_version() == 0 and store.canary_version() == 1
+
+    assert store.promote_canary() == 1
+    assert store.current_version() == 1
+    assert store.canary_version() is None
+    assert store.promote_canary() == 1  # idempotent: nothing pending
+
+
+def test_canary_rollback_records_and_reuses_version(tmp_path):
+    """rollback_canary ledgers the rejection, deletes the candidate dir,
+    and frees the version NUMBER for the next candidate — whose different
+    bytes at the same version must publish and promote cleanly."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    bad = _toy_delta(tmp_path / "bad", store, seed=1)
+    store.publish_canary(bad)
+    bad_digest = _digest_of(store.versions / "v000001")
+    assert store.rollback_canary("canary AUC regression") == 0
+    assert store.canary_version() is None
+    assert not (store.versions / "v000001").exists()
+    rej = store.rejections()
+    assert [r["version"] for r in rej] == [1]
+    assert rej[0]["digest"] == bad_digest
+    assert rej[0]["reason"] == "canary AUC regression"
+    # rollback is idempotent: redo records nothing twice
+    store.rollback_canary("canary AUC regression")
+    assert len(store.rejections()) == 1
+
+    good = _toy_delta(tmp_path / "good", store, seed=2)
+    assert _digest_of(good) != _digest_of(bad)
+    assert store.publish_canary(good) == 1  # the NUMBER is reusable
+    assert store.promote_canary() == 1
+    assert store.current_version() == 1
+    assert _digest_of(store.current_dir()) != bad_digest
+
+
+def test_recover_clears_pointer_only_canary(tmp_path):
+    """The publish_canary crash window: pointer written, directory never
+    published.  recover() clears the dangling pointer and leaves CURRENT
+    alone — the supervisor's redo republishes identical bytes."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    atomic_write_json(store.root / "CANARY",
+                      {"version": 1, "digest": "f" * 16})
+    assert store.recover() == 0
+    assert store.canary_version() is None
+    assert store.current_version() == 0
+
+
+def test_recover_never_adopts_unvetted_canary(tmp_path):
+    """A crash mid-watch leaves a fully-published, digest-valid canary
+    directory.  recover() must NOT adopt it as CURRENT (it is staged but
+    unvetted); the pointer and directory survive for the supervisor's
+    verdict redo."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    store.publish_canary(_toy_delta(tmp_path / "d1", store))
+    assert store.recover() == 0  # newest-first walk skipped the canary
+    assert store.current_version() == 0
+    assert store.canary_version() == 1
+    assert (store.versions / "v000001" / "bundle.json").exists()
+
+
+def test_recover_finishes_crashed_promotion(tmp_path):
+    """Promotion writes CURRENT first, then clears CANARY.  A kill in
+    between leaves canary <= current: recover() treats that as a COMPLETED
+    promotion — clears the stale pointer, never regresses CURRENT."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    store.publish_canary(_toy_delta(tmp_path / "d1", store))
+    can = json.loads((store.root / "CANARY").read_text())
+    atomic_write_json(store.root / "CURRENT", can)  # promote's first half
+    assert store.recover() == 1
+    assert store.current_version() == 1
+    assert store.canary_version() is None
+
+
+def test_recover_finishes_crashed_rollback(tmp_path):
+    """Rollback records the rejection FIRST; a kill before the directory
+    delete leaves the rejected bytes published.  recover() prunes them by
+    (version, digest) and never re-adopts."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    store.publish_canary(_toy_delta(tmp_path / "d1", store))
+    digest = _digest_of(store.versions / "v000001")
+    store._record_rejection(1, digest, "canary AUC regression")
+    # ...crash here: dir + CANARY pointer still on disk
+    assert store.recover() == 0
+    assert store.current_version() == 0
+    assert store.canary_version() is None
+    assert not (store.versions / "v000001").exists()
+
+
+def test_recover_rejects_corrupt_canary_bytes(tmp_path):
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    store.publish_canary(_toy_delta(tmp_path / "d1", store))
+    vdir = store.versions / "v000001"
+    m, a = read_raw_bundle(vdir)
+    t = np.array(a["params:w"])
+    t.view(np.uint8).reshape(-1)[0] ^= 0xFF
+    write_raw_bundle(vdir, m, dict(a, **{"params:w": t}))
+    assert store.recover() == 0
+    assert store.canary_version() is None  # torn candidate: redo republishes
+    assert not vdir.exists()
+
+
+def test_keep_versions_gc_protects_live_chain(tmp_path):
+    """[serving] keep_versions retention: promotes prune history beyond the
+    budget but NEVER the current, canary, or last-good directories."""
+    store = BundleStore(tmp_path / "store", keep_versions=2)
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    for v in (1, 2, 3, 4):
+        store.publish_canary(_toy_delta(tmp_path / f"d{v}", store, seed=v))
+        assert store.promote_canary() == v
+    live = sorted(p.name for p in store.versions.iterdir())
+    # v4 is CURRENT (protected), v3+v2 are the retention budget
+    assert live == ["v000002", "v000003", "v000004"]
+
+    # a pending canary is protected OUTSIDE the budget: it neither counts
+    # as a survivor nor gets pruned while the watch runs
+    store.publish_canary(_toy_delta(tmp_path / "d5", store, seed=5))
+    assert store.gc_versions() == []
+    assert sorted(p.name for p in store.versions.iterdir()) == \
+        ["v000002", "v000003", "v000004", "v000005"]
+    # promoting it slides the retention window by one
+    assert store.promote_canary() == 5
+    assert sorted(p.name for p in store.versions.iterdir()) == \
+        ["v000003", "v000004", "v000005"]
+
+
+def test_keep_versions_zero_disables_gc(tmp_path):
+    store = BundleStore(tmp_path / "store")  # keep_versions=0
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    for v in (1, 2, 3):
+        store.publish_canary(_toy_delta(tmp_path / f"d{v}", store, seed=v))
+        store.promote_canary()
+    assert len(list(store.versions.iterdir())) == 4  # everything kept
+    assert store.gc_versions() == []
+
+
+def test_gc_refuses_while_current_corrupt(tmp_path):
+    """The sweep digest-verifies CURRENT first: with a corrupt serving
+    head, history is fallback material and nothing is deleted."""
+    store = BundleStore(tmp_path / "store", keep_versions=1)
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    for v in (1, 2, 3):
+        store.publish_canary(_toy_delta(tmp_path / f"d{v}", store, seed=v))
+        store.promote_canary()
+    cur = store.current_dir()
+    m, a = read_raw_bundle(cur)
+    t = np.array(a["params:w"])
+    t.view(np.uint8).reshape(-1)[0] ^= 0xFF
+    write_raw_bundle(cur, m, dict(a, **{"params:w": t}))
+    assert store.gc_versions() == []  # refuse: the head cannot be trusted
+    # recover() falls back to the newest intact version, THEN sweeps
+    assert store.recover() == 2
+    assert not cur.exists()
+
+
+def test_swap_controller_degraded_clears_via_poll_repair(tmp_path):
+    """Satellite regression: a frontend driven into degraded mode by real
+    corrupt deltas must recover WITHOUT an operator poke when the exporter
+    re-writes good bytes at the same quarantined chain position — the
+    ``SwapController.poll`` on-disk re-verification path."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    chain = tmp_path / "chain"
+    delta = _toy_delta(chain / "v000001", store)
+    good = read_raw_bundle(delta)
+
+    ctrl = SwapController(store, lambda d: (lambda b: b), batcher=None,
+                          max_bad_deltas=2)
+    poller = DeltaPoller(chain, poll_s=0.0, clock=lambda: 0.0)
+    try:
+        # TWO real corrupt reads (bit-flipped in memory) through the poll
+        # path: quarantined both times, degraded flips at the budget
+        faults.configure(FaultSpec(corrupt_delta_nth=1))
+        assert ctrl.poll(poller) is False
+        assert ctrl.consecutive_bad == 1 and not ctrl.degraded
+        faults.configure(FaultSpec(corrupt_delta_nth=1))
+        assert ctrl.poll(poller) is False
+    finally:
+        faults.configure(None)
+    assert ctrl.degraded and ctrl.consecutive_bad == 2
+    assert store.current_version() == 0
+    assert {q["path"] for q in store.quarantined()} == {str(delta)}
+
+    # the exporter heals the chain position with verifiably good bytes;
+    # the very next poll re-verifies, applies, and clears degraded mode
+    write_raw_bundle(delta, *good)
+    assert ctrl.poll(poller) is True
+    assert store.current_version() == 1
+    assert not ctrl.degraded and ctrl.consecutive_bad == 0
+
+
+def test_swap_controller_poll_skips_still_corrupt_quarantined(tmp_path):
+    """The re-verification gate's other half: a quarantined path whose
+    bytes are STILL corrupt on disk is never re-applied (no quarantine
+    loop), and the store keeps serving the last good version."""
+    store = BundleStore(tmp_path / "store")
+    store.ingest_full(_toy_bundle(tmp_path / "b0", 0))
+    chain = tmp_path / "chain"
+    delta = _toy_delta(chain / "v000001", store)
+    m, a = read_raw_bundle(delta)
+    bad = np.array(a["params:w"])
+    bad.view(np.uint8).reshape(-1)[0] ^= 0xFF
+    write_raw_bundle(delta, m, dict(a, **{"params:w": bad}))  # torn on DISK
+
+    ctrl = SwapController(store, lambda d: (lambda b: b), batcher=None)
+    poller = DeltaPoller(chain, poll_s=0.0, clock=lambda: 0.0)
+    assert ctrl.poll(poller) is False  # quarantined on first contact
+    assert ctrl.consecutive_bad == 1
+    for _ in range(3):
+        assert ctrl.poll(poller) is False  # still-bad bytes: gate holds
+    assert ctrl.consecutive_bad == 1  # no re-apply, no counter churn
+    assert store.current_version() == 0
+
+
 # ------------------------------------------------- kill/restart mid-swap
 
 
