@@ -1175,6 +1175,35 @@ def bench_retrieval_scale(n_items_list=(1_000_000, 10_000_000),
     return out
 
 
+def bench_trace_overhead(run, make_args, ks=(5, 45), reps: int = 3) -> dict:
+    """``trace_overhead``: the headline step chain re-timed with
+    ``[telemetry] trace = true`` live (sinks in a throwaway dir) vs off.
+
+    The step PROGRAM contains no trace calls — spans are host-side emits at
+    serve/replay/cycle boundaries, and ``obs/trace.emit`` early-returns when
+    unconfigured — so the on-vs-off delta is the claim itself: it must sit
+    inside chain-differencing noise.  tests/test_trace.py pins the stronger
+    static fact (trace on adds ZERO step-program equations, jaxpr
+    byte-identity); this record is the measured companion.  Recipe and
+    expected numbers: docs/BUDGET.md "trace overhead"."""
+    import tempfile
+
+    from tdfo_tpu.obs import trace as obs_trace
+
+    sec_off = chain_time(run, make_args, ks=ks, reps=reps)
+    with tempfile.TemporaryDirectory() as td:
+        obs_trace.configure(td)
+        try:
+            sec_on = chain_time(run, make_args, ks=ks, reps=reps)
+        finally:
+            obs_trace.configure(None)
+    return {
+        "step_ms_trace_off": round(sec_off * 1e3, 3),
+        "step_ms_trace_on": round(sec_on * 1e3, 3),
+        "on_over_off": round(sec_on / sec_off, 4) if sec_off else None,
+    }
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch-size", type=int, default=8192)
@@ -1208,6 +1237,10 @@ def main() -> None:
     ap.add_argument("--skip-planner", action="store_true",
                     help="dlrm-criteo only: skip the planner-vs-defaults "
                          "record (planner_dlrm8)")
+    ap.add_argument("--skip-trace-overhead", action="store_true",
+                    help="skip the trace on-vs-off step-chain record "
+                         "(trace_overhead) — re-times the headline chain "
+                         "once more with span sinks live")
     ap.add_argument("--skip-retrieval-scale", action="store_true",
                     help="skip the 1M/10M-corpus exact-vs-two-stage record "
                          "(retrieve_twostage8) — the slowest serving record "
@@ -1326,6 +1359,14 @@ def main() -> None:
             print(f"bench: retrieval-scale bench failed: {e!r}",
                   file=sys.stderr)
 
+    trace_overhead = {}
+    if on_tpu and not args.skip_trace_overhead:
+        try:
+            trace_overhead = bench_trace_overhead(run, make_args)
+        except Exception as e:  # trace record must never kill the headline
+            print(f"bench: trace-overhead bench failed: {e!r}",
+                  file=sys.stderr)
+
     planner_rec = {}
     if args.model == "dlrm-criteo" and not args.skip_planner:
         # predictions are cheap host math and always emitted; the measured
@@ -1379,6 +1420,7 @@ def main() -> None:
         "cache_zipf": cache_zipf,
         "retrieve_twostage8": retrieval_scale,
         "planner_dlrm8": planner_rec,
+        "trace_overhead": trace_overhead,
         "spec_assumed": spec_assumed,
         "device_kind": jax.devices()[0].device_kind,
         "config": bench_config,

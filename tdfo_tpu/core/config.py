@@ -287,11 +287,21 @@ class TelemetrySpec:
     # 0 disables the watchdog thread (heartbeat.jsonl is not written).
     stall_timeout_s: float = 0.0
     # size-based rotation for the run's append-only JSONL sinks
-    # (metrics.jsonl via MetricLogger, retries.jsonl via utils/retry): when
-    # a sink crosses this many bytes it is atomically renamed to `<name>.1`
-    # (replacing any previous overflow) and a fresh file continues — a
-    # long-running online loop must not fill the disk.  0 = unbounded.
+    # (metrics.jsonl via MetricLogger, retries.jsonl via utils/retry,
+    # events.jsonl, heartbeat*.jsonl, and the trace-*.jsonl span sinks):
+    # when a sink crosses this many bytes it is atomically renamed to
+    # `<name>.1` (replacing any previous overflow) and a fresh file
+    # continues — a long-running online loop must not fill the disk.
+    # 0 = unbounded.
     log_rotate_bytes: int = 0
+    # span-based causal tracing (tdfo_tpu/obs/trace.py): every component of
+    # the online loop appends correlation-id-carrying spans to per-component
+    # trace-*.jsonl sinks under <out_dir>/trace, assembled offline by
+    # `launch.py obs` into per-cycle causal timelines, freshness lag, and
+    # fleet latency percentiles.  Spans are host-side only: false (the
+    # default) emits nothing and the step program is byte-identical either
+    # way (pinned by tests/test_trace.py).
+    trace: bool = False
 
 
 @dataclass(frozen=True)
@@ -347,6 +357,14 @@ class OnlineSpec:
     # canary watch rolls back when canary-replica AUC falls more than this
     # below the stable replicas.
     max_auc_regression: float = 0.02
+    # latency verdict term for the canary watch: roll the candidate back
+    # when the canary cohort's heartbeat-scoring p99 exceeds the stable
+    # cohort's p99 by more than this many milliseconds across the watch
+    # window (nearest-rank percentile, obs/aggregate.percentile — the same
+    # statistic `launch.py obs` reports offline).  Catches regressions AUC
+    # cannot see (a slow scorer serves stale ranking under load).  0
+    # disables the term; requires canary_cycles > 0 to mean anything.
+    max_p99_regression_ms: float = 0.0
     # replay batches held out per gated cycle as the shadow-eval slice:
     # traffic the candidate has NOT trained on (it trains in a later cycle
     # — progressive validation), scored by candidate + baseline for the
@@ -858,6 +876,11 @@ class Config:
             raise ValueError(
                 "online max_auc_regression must be >= 0 (the tolerated "
                 "held-out/canary AUC drop)")
+        if self.online.max_p99_regression_ms < 0:
+            raise ValueError(
+                "online max_p99_regression_ms must be >= 0 (0 disables the "
+                "latency verdict term; positive = the tolerated canary-over-"
+                "stable heartbeat p99 excess in milliseconds)")
         if self.online.shadow_eval_batches < 1:
             raise ValueError(
                 "online shadow_eval_batches must be >= 1: the gate needs "

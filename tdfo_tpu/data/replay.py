@@ -62,6 +62,7 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from tdfo_tpu.obs import trace as _trace
 from tdfo_tpu.utils import faults as _faults
 
 __all__ = [
@@ -334,6 +335,7 @@ class ReplayConsumer:
             cur.update({k: int(v) for k, v in cursor.items()})
         self._cursor = cur
         self._verified: set[int] = set()
+        self._peeking = False  # suppress trace spans for uncommitted reads
 
     # ------------------------------------------------------------- segments
 
@@ -572,6 +574,14 @@ class ReplayConsumer:
             return None  # not enough durable rows: all-or-nothing, no commit
         batch = {col: np.concatenate(parts) for col, parts in taken.items()}
         self._cursor = cur
+        # span AFTER the in-memory commit: what the trace claims consumed
+        # is exactly what the cursor advanced over ((seq, lo, hi) spans —
+        # obs/aggregate.py normalises them to (replica=0, seq) join keys).
+        # Peeks (shadow eval) never emit — they commit nothing.
+        if not self._peeking:
+            _trace.emit("replay", "replay_batch", rows=self.batch_size,
+                        consumed=[list(c) for c in consumed],
+                        records=cur["records"])
         inj = _faults.active()
         if inj is not None:
             inj.maybe_kill_replay(cur["records"])
@@ -585,6 +595,7 @@ class ReplayConsumer:
         held-out.  Returns fewer than ``n`` batches when the log drains."""
         saved = dict(self._cursor)
         out = []
+        self._peeking = True
         try:
             for _ in range(int(n)):
                 got = self.next_batch()
@@ -592,6 +603,7 @@ class ReplayConsumer:
                     break
                 out.append(got[0])
         finally:
+            self._peeking = False
             self._cursor = saved
         return out
 
@@ -691,6 +703,7 @@ class MergedReplayConsumer:
             for i in ids
         }
         self.schema = dict(schema)
+        self._peeking = False  # suppress trace spans for uncommitted reads
 
     def next_batch(self):
         """One deterministic ``batch_size``-row batch round-robined across
@@ -727,6 +740,12 @@ class MergedReplayConsumer:
         for i, s in self._subs.items():
             s._cursor = curs[i]
         self._rr = rr % len(ids)
+        # (replica, seq, lo, hi) spans — the merged half of the causal
+        # chain: these ids are the ones served-request spans carry.
+        # Peeks (shadow eval) never emit — they commit nothing.
+        if not self._peeking:
+            _trace.emit("replay", "replay_batch", rows=self.batch_size,
+                        consumed=[list(c) for c in consumed])
         inj = _faults.active()
         if inj is not None:
             inj.maybe_kill_replay(
@@ -739,6 +758,7 @@ class MergedReplayConsumer:
         saved = {i: dict(s._cursor) for i, s in self._subs.items()}
         saved_rr = self._rr
         out = []
+        self._peeking = True
         try:
             for _ in range(int(n)):
                 got = self.next_batch()
@@ -746,6 +766,7 @@ class MergedReplayConsumer:
                     break
                 out.append(got[0])
         finally:
+            self._peeking = False
             for i, s in self._subs.items():
                 s._cursor = saved[i]
             self._rr = saved_rr

@@ -23,6 +23,11 @@ Subcommands:
     against the measured cost model (``tdfo_tpu/plan``) using the
     preprocessing ``table_stats.json`` and write ``sharding_plan.json``;
     knobs live in the ``[planner]`` config table.
+  * ``obs``                  — assemble the causal trace sinks written by a
+    ``[telemetry] trace = true`` run (``trace-*.jsonl`` under
+    checkpoint_dir/log_dir) into per-cycle timelines, freshness lag and
+    fleet latency histograms (``tdfo_tpu/obs/aggregate.py``); writes a
+    ``chrome_trace.json`` loadable in ``chrome://tracing`` / Perfetto.
   * ``preprocess-ctr``       — TwoTower ETL (jax-flax/preprocessing parity).
   * ``preprocess-seq``       — Bert4Rec ETL (torchrec/preprocessing parity).
   * ``preprocess-criteo``    — Criteo-format ETL (BASELINE.json DLRM family).
@@ -52,7 +57,7 @@ def _init_distributed(flag: str) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
     p.add_argument("command", nargs="?", default="train",
-                   choices=["train", "serve", "online", "plan",
+                   choices=["train", "serve", "online", "plan", "obs",
                             "preprocess-ctr", "preprocess-seq",
                             "preprocess-criteo", "synth", "synth-criteo"])
     p.add_argument("--config", default="config.toml", help="path to config.toml")
@@ -137,6 +142,34 @@ def main(argv: list[str] | None = None) -> int:
         path = write_plan(cfg.data_dir, plan)
         print(format_plan(plan))
         print(f"plan written to {path}")
+        return 0
+    if args.command == "obs":
+        # pure host work: fold the trace sinks of a finished (or killed)
+        # traced run into one causal report — no devices, no distributed
+        # init needed
+        import json
+        from pathlib import Path
+
+        from tdfo_tpu.obs.aggregate import (assemble, chrome_trace,
+                                            format_report, load_spans)
+
+        out_dir = args.log_dir or cfg.checkpoint_dir
+        if not out_dir:
+            raise SystemExit(
+                "obs needs the traced run's output dir — set checkpoint_dir "
+                "in the config or pass --log-dir")
+        trace_dir = Path(out_dir) / "trace"
+        spans = load_spans(trace_dir)
+        if not spans:
+            raise SystemExit(
+                f"no trace-*.jsonl spans under {trace_dir} — run with "
+                "[telemetry] trace = true first")
+        report = assemble(spans)
+        print(format_report(report))
+        chrome_path = trace_dir / "chrome_trace.json"
+        chrome_path.write_text(json.dumps(chrome_trace(spans)))
+        print(f"chrome trace written to {chrome_path} "
+              "(load in chrome://tracing or ui.perfetto.dev)")
         return 0
     if args.command == "preprocess-seq":
         from tdfo_tpu.data.seq_preprocessing import run_seq_preprocessing

@@ -9,9 +9,15 @@ Three independent pieces behind the validated ``[telemetry]`` config table:
   sampler appending to ``events.jsonl``.
 - ``watchdog``  — daemon thread writing ``heartbeat.jsonl`` and dumping all
   thread stacks when no step completes within the stall timeout.
+- ``trace``     — span-based causal tracing across the online loop
+  (``[telemetry] trace``): per-component ``trace-*.jsonl`` sinks carrying
+  propagated ``(replica, seq)`` / cycle / version correlation ids.
+- ``aggregate`` — offline assembler joining the trace sinks into per-cycle
+  causal timelines, freshness lag, Chrome-trace export, and the fleet
+  latency percentiles (``launch.py obs``).
 """
 
-from tdfo_tpu.obs import counters, events
+from tdfo_tpu.obs import aggregate, counters, events, trace
 from tdfo_tpu.obs.watchdog import StallWatchdog
 
-__all__ = ["counters", "events", "StallWatchdog"]
+__all__ = ["aggregate", "counters", "events", "trace", "StallWatchdog"]

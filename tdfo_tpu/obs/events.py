@@ -43,8 +43,9 @@ _ACTIVE: Optional["_Recorder"] = None
 
 
 class _Recorder:
-    def __init__(self, path):
+    def __init__(self, path, rotate_bytes: int = 0):
         self.path = os.fspath(path)
+        self.rotate_bytes = int(rotate_bytes)
         self.after_warmup = False
         self.counts: dict = {}   # (kind, name) -> occurrences
         self.peak_bytes: dict = {}  # device label -> max bytes_in_use seen
@@ -53,9 +54,15 @@ class _Recorder:
         open(self.path, "w").close()
 
     def record(self, kind: str, **fields) -> None:
+        from tdfo_tpu.utils.logrotate import maybe_rotate_path
+
         rec = {"time": time.time(), "kind": kind, **fields}
         with open(self.path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        if self.rotate_bytes:
+            # between complete appends, the closed-file shape: a kill at
+            # any point leaves only whole lines in either generation
+            maybe_rotate_path(self.path, self.rotate_bytes)
 
 
 class _JaxCompileHandler(logging.Handler):
@@ -108,8 +115,10 @@ _SAVED_PROPAGATE: Optional[bool] = None
 _FWD_LEVEL: Optional[int] = None
 
 
-def configure(path=None) -> None:
-    """Start recording to ``path`` (``events.jsonl``); ``None`` stops."""
+def configure(path=None, *, rotate_bytes: int = 0) -> None:
+    """Start recording to ``path`` (``events.jsonl``); ``None`` stops.
+    ``rotate_bytes > 0`` caps the sink via ``[telemetry] log_rotate_bytes``
+    (one ``.1`` overflow generation, the MetricLogger discipline)."""
     global _ACTIVE, _HANDLER, _SAVED_LEVEL, _SAVED_PROPAGATE, _FWD_LEVEL
     jl = logging.getLogger(_JAX_LOGGER_NAME)
     with _LOCK:
@@ -126,7 +135,7 @@ def configure(path=None) -> None:
                 _SAVED_PROPAGATE = None
             _FWD_LEVEL = None
             return
-        _ACTIVE = _Recorder(path)
+        _ACTIVE = _Recorder(path, rotate_bytes=rotate_bytes)
         if _HANDLER is None:
             _HANDLER = _JaxCompileHandler(level=logging.DEBUG)
             _SAVED_LEVEL = jl.level

@@ -43,9 +43,11 @@ class StallWatchdog:
     """
 
     def __init__(self, heartbeat_path, timeout_s: float, *,
-                 clock=time.monotonic, label: str = "train"):
+                 clock=time.monotonic, label: str = "train",
+                 rotate_bytes: int = 0):
         self.path = os.fspath(heartbeat_path)
         self.timeout_s = float(timeout_s)
+        self.rotate_bytes = int(rotate_bytes)
         self.label = str(label)
         self._clock = clock
         self._lock = threading.Lock()
@@ -93,8 +95,14 @@ class StallWatchdog:
     # ------------------------------------------------------------ daemon
 
     def _write(self, rec: dict) -> None:
+        from tdfo_tpu.utils.logrotate import maybe_rotate_path
+
         with open(self.path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        if self.rotate_bytes:
+            # rotation happens between complete appends (closed file), so a
+            # kill at any byte leaves whole lines in both generations
+            maybe_rotate_path(self.path, self.rotate_bytes)
 
     def check(self) -> bool:
         """One watchdog pass (the daemon's body; callable from tests).

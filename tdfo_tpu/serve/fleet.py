@@ -31,12 +31,12 @@ round, in-process, so restart lineages see identical membership.
 
 from __future__ import annotations
 
-import time
 from pathlib import Path
 from typing import Any, Callable
 
 import numpy as np
 
+from tdfo_tpu.obs import trace as _trace
 from tdfo_tpu.serve.export import load_bundle
 from tdfo_tpu.serve.frontend import MicroBatcher
 from tdfo_tpu.serve.scoring import make_scorer
@@ -70,12 +70,12 @@ class ReplicaFrontend:
         self.canary_member = bool(canary_member)
         self._logger = logger
         self.batcher: MicroBatcher | None = None
-        # (version, digest, skewed): skew membership is part of the served
-        # identity — a restart lineage may sync onto a pending canary
-        # BEFORE the supervisor re-arms the skew fault, and the later sync
-        # must then reload the same bytes with the skewed scorer or the
-        # two lineages diverge.
-        self._served: tuple[int, str, bool] | None = None
+        # (version, digest, skewed, slow): fault membership is part of the
+        # served identity — a restart lineage may sync onto a pending
+        # canary BEFORE the supervisor re-arms the skew/slow fault, and
+        # the later sync must then reload the same bytes with the faulted
+        # scorer or the two lineages diverge.
+        self._served: tuple[int, str, bool, bool] | None = None
         self._score_fn: Callable | None = None
         self._request_log = None
         if request_log_root is not None:
@@ -95,15 +95,17 @@ class ReplicaFrontend:
                 return can
         return self.store._read_pointer("CURRENT")
 
-    def sync(self, skew_digests: frozenset[str] = frozenset()) -> int | None:
+    def sync(self, skew_digests: frozenset[str] = frozenset(),
+             slow_digests: frozenset[str] = frozenset()) -> int | None:
         """Follow this replica's pointer; reload iff (version, digest,
-        skewed) changed.  Returns the version now being served (None =
-        empty store, nothing to serve yet)."""
+        skewed, slow) changed.  Returns the version now being served
+        (None = empty store, nothing to serve yet)."""
         ptr = self._target_pointer()
         if ptr is None:
             return None
         skewed = str(ptr["digest"]) in skew_digests
-        key = (int(ptr["version"]), str(ptr["digest"]), skewed)
+        slow = str(ptr["digest"]) in slow_digests
+        key = (int(ptr["version"]), str(ptr["digest"]), skewed, slow)
         if key == self._served:
             return key[0]
         version = key[0]
@@ -123,6 +125,19 @@ class ReplicaFrontend:
             cache_probe = None  # nothing jitted behind the heuristic
         else:
             score_fn = scorer.score
+        if slow:
+            # latency-regression stand-in (slow_canary_at_cycle): correct
+            # logits, slow scorer — only replicas serving THIS digest pay
+            # the sleep, so heartbeat p99s diverge by cohort and the
+            # [online] max_p99_regression_ms verdict term has a signal
+            inner = score_fn
+
+            def score_fn(batch, _inner=inner):
+                inj = _faults.active()
+                if inj is not None:
+                    inj.slow_score_sleep()
+                return _inner(batch)
+
         self._score_fn = score_fn
         if self.batcher is None:
             self.batcher = MicroBatcher(
@@ -133,11 +148,19 @@ class ReplicaFrontend:
                 max_queue=self.spec.max_queue,
                 shed_policy=self.spec.shed_policy,
                 request_log=self._request_log)
+            self.batcher.replica = self.replica_id
             self.batcher._version = version
+            self.batcher._digest = key[1]
         else:
-            self.batcher.swap(score_fn, version=version,
+            self.batcher.swap(score_fn, version=version, digest=key[1],
                               program_cache_size=cache_probe)
         self._served = key
+        # the freshness-lag anchor: when a version first goes live on a
+        # replica outside a promote flip (obs/aggregate.py uses the
+        # earliest of either)
+        _trace.emit("fleet", "replica_sync", replica=self.replica_id,
+                    version=version, digest=key[1],
+                    canary=self.canary_member, skewed=skewed, slow=slow)
         return version
 
     # -------------------------------------------------------------- serve
@@ -193,6 +216,8 @@ class ServingFleet:
         self.store = store
         self._dead: set[int] = set()
         self._skew_digests: set[str] = set()
+        self._slow_digests: set[str] = set()
+        self._warmed: set[tuple] = set()
         self._logger = logger
 
     # ------------------------------------------------------------ members
@@ -225,13 +250,22 @@ class ServingFleet:
         bytes, which must serve honestly."""
         self._skew_digests.add(str(digest))
 
+    def set_score_slow(self, digest: str) -> None:
+        """Arm the latency-regression fault (``slow_canary_at_cycle``) for
+        the bundle with this digest: any replica that syncs onto it scores
+        through a ``slow_score_ms`` host sleep.  Digest-keyed for the same
+        reason as :meth:`set_score_skew` — rollback reuses version numbers
+        for different bytes, which must serve at full speed."""
+        self._slow_digests.add(str(digest))
+
     # -------------------------------------------------------------- sync
 
     def sync(self) -> dict[int, int | None]:
         """Point every alive replica at its pointer; returns the served
         version per replica id."""
         skew = frozenset(self._skew_digests)
-        return {r.replica_id: r.sync(skew) for r in self.alive()}
+        slow = frozenset(self._slow_digests)
+        return {r.replica_id: r.sync(skew, slow) for r in self.alive()}
 
     def versions(self) -> dict[int, int | None]:
         return {r.replica_id: r.version() for r in self.alive()}
@@ -241,19 +275,37 @@ class ServingFleet:
     def heartbeat(self, feats: dict[str, np.ndarray],
                   labels: np.ndarray) -> list[dict[str, Any]]:
         """One health sample per alive replica on a held-out slice:
-        ``{replica, version, auc, ms, canary}``.  Fresh arrays per call —
-        the scorer donates its inputs."""
+        ``{replica, version, auc, ms, canary, queue_depth, batch_fill}``
+        (the saturation pair mirrored from the replica's micro-batcher).
+        Fresh arrays per call — the scorer donates its inputs.  Each
+        sample is also emitted as a ``heartbeat`` trace span: the ``ms``
+        samples are what the offline p50/p99 histograms and the online
+        ``max_p99_regression_ms`` verdict term are computed from.
+
+        A replica's FIRST sample on a freshly-synced scorer is preceded by
+        one unmeasured warm-up score: jit compilation is a one-time cost
+        the canary cohort would otherwise pay on EVERY cycle (its bundle
+        is always new) while the stable cohort never does — a constant
+        false p99 regression that would mask or mimic real slowdowns."""
         out = []
         for r in self.alive():
-            t0 = time.monotonic()
+            if (r.replica_id, r._served) not in self._warmed:
+                self._warmed.add((r.replica_id, r._served))
+                r.score_direct({k: np.array(v) for k, v in feats.items()})
+            t0 = _trace.clock()
             scores = r.score_direct(
                 {k: np.array(v) for k, v in feats.items()})
-            ms = (time.monotonic() - t0) * 1000.0
-            out.append({
+            ms = _trace.elapsed_ms(t0)
+            rec: dict[str, Any] = {
                 "replica": r.replica_id, "version": r.version(),
                 "auc": binary_auc(labels, scores), "ms": ms,
                 "canary": r.canary_member,
-            })
+            }
+            if r.batcher is not None:
+                rec["queue_depth"] = r.batcher.last_queue_depth
+                rec["batch_fill"] = r.batcher.last_batch_fill
+            _trace.emit("fleet", "heartbeat", **rec)
+            out.append(rec)
         return out
 
     # -------------------------------------------------------------- serve

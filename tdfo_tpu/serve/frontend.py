@@ -40,6 +40,8 @@ from typing import Any, Callable, Mapping
 
 import numpy as np
 
+from tdfo_tpu.obs import trace as _trace
+
 __all__ = ["MicroBatcher", "serve_from_config"]
 
 
@@ -114,6 +116,14 @@ class MicroBatcher:
         self.shed: list[tuple[Any, str]] = []  # (request_id, reason)
         self.swaps: list[dict[str, Any]] = []
         self._version: Any = None  # bundle chain version being served
+        self._digest: Any = None   # served bundle digest (trace identity)
+        # trace identity: which fleet replica this batcher serves for (the
+        # ReplicaFrontend stamps it; 0 for the single-frontend layout)
+        self.replica = 0
+        # saturation fields of the LAST shipped batch — the fleet heartbeat
+        # merges these into its per-replica health record
+        self.last_queue_depth = 0
+        self.last_batch_fill = 0.0
         self._swapping = False
         self._under_swap_ms: list[float] = []
 
@@ -235,8 +245,8 @@ class MicroBatcher:
         done = self._clock()
         # saturation observability: requests still waiting after this ship,
         # and how much of the padded program the batch actually used
-        depth = len(self._pending)
-        fill = rows / padded
+        depth = self.last_queue_depth = len(self._pending)
+        fill = self.last_batch_fill = rows / padded
         off = 0
         for rid, cols, n, t0 in take:
             self.results[rid] = scores[off:off + n]
@@ -250,11 +260,19 @@ class MicroBatcher:
                 label = self._labels.pop(rid, None)
                 if label is not None:
                     feats["label"] = label.tolist()
-                self._request_log.append({
+                seq = self._request_log.append({
                     "event": "serve_request", "request": str(rid),
                     "rows": n, "outcome": "ok", "features": feats,
                     "under_swap": self._swapping, "version": self._version,
                     "latency_ms": latency_ms})
+                # the causal-chain anchor: (replica, seq) is the id the
+                # replay batch span quotes back, (version, digest) is what
+                # served it — obs/aggregate.py joins the two offline
+                _trace.emit(
+                    "frontend", "serve_request", replica=self.replica,
+                    seq=seq, version=self._version, digest=self._digest,
+                    rows=n, latency_ms=round(latency_ms, 3),
+                    queue_depth=depth, batch_fill=round(fill, 4))
             if self._logger is not None:
                 self._logger.log(event="serve_request", request=str(rid),
                                  rows=n, batch_rows=rows, padded=padded,
@@ -266,6 +284,7 @@ class MicroBatcher:
     # ------------------------------------------------------------ hot swap
 
     def swap(self, score_fn: Callable, *, version: Any = None,
+             digest: Any = None,
              program_cache_size: Callable[[], int] | None = None) -> float:
         """Flip to a new scorer without dropping accepted traffic.
 
@@ -289,9 +308,14 @@ class MicroBatcher:
         # the old scorer's program-cache probe is stale the moment we flip
         self._cache_size = program_cache_size
         old_version, self._version = self._version, version
+        self._digest = digest
         swap_ms = (self._clock() - t0) * 1000.0
         self.swaps.append({"version": version, "from_version": old_version,
                            "drained_rows": drained, "swap_ms": swap_ms})
+        _trace.emit("frontend", "swap", replica=self.replica,
+                    version=version, digest=digest,
+                    from_version=old_version, drained_rows=drained,
+                    swap_ms=round(swap_ms, 3))
         if self._request_log is not None:
             # replay SKIPS non-request events; recording the swap in-stream
             # timestamps which traffic each served version covers
@@ -417,9 +441,9 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
             request_log_root=(base / "request_log" if spec.log_features
                               else None))
         flt.sync()
-        t0 = time.monotonic()
+        t0 = _trace.clock()
         flt.run(requests)
-        wall = time.monotonic() - t0
+        wall = _trace.elapsed_s(t0)
         reps = [r for r in flt.alive() if r.batcher is not None]
         lat = np.asarray([ms for r in reps for ms in r.batcher.latencies_ms],
                          np.float64)
@@ -443,9 +467,10 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
 
             watchdog = StallWatchdog(
                 base / "heartbeat_serve.jsonl",
-                config.telemetry.stall_timeout_s, label="serve").start()
+                config.telemetry.stall_timeout_s, label="serve",
+                rotate_bytes=config.telemetry.log_rotate_bytes).start()
 
-        t0 = time.monotonic()
+        t0 = _trace.clock()
         mb = MicroBatcher(
             scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
             batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
@@ -453,7 +478,7 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
             max_queue=spec.max_queue, shed_policy=spec.shed_policy,
             watchdog=watchdog, request_log=request_log)
         mb.run(requests)
-        wall = time.monotonic() - t0
+        wall = _trace.elapsed_s(t0)
         if watchdog is not None:
             watchdog.stop()
         stats = mb.stats()

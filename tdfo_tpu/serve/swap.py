@@ -67,6 +67,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from tdfo_tpu.obs import trace as _trace
 from tdfo_tpu.serve.export import (
     apply_delta_arrays,
     bundle_digest,
@@ -242,6 +243,8 @@ class BundleStore:
         publish_dir(staged, final)
         atomic_write_json(self.root / _CURRENT,
                           {"version": version, "digest": manifest["digest"]})
+        _trace.emit("swap", "pointer_flip", op="publish", pointer=_CURRENT,
+                    version=version, digest=manifest["digest"])
         return final
 
     def ingest_full(self, bundle_dir: str | Path) -> int:
@@ -355,6 +358,8 @@ class BundleStore:
         final = self.versions / _version_name(version)
         atomic_write_json(self.root / _CANARY,
                           {"version": version, "digest": manifest["digest"]})
+        _trace.emit("swap", "pointer_flip", op="canary", pointer=_CANARY,
+                    version=version, digest=manifest["digest"])
         if final.exists():
             try:
                 m, a = read_raw_bundle(final)
@@ -398,6 +403,8 @@ class BundleStore:
         atomic_write_json(self.root / _CURRENT,
                           {"version": can["version"], "digest": can["digest"]})
         (self.root / _CANARY).unlink(missing_ok=True)
+        _trace.emit("swap", "pointer_flip", op="promote", pointer=_CURRENT,
+                    version=can["version"], digest=can["digest"])
         self.gc_versions()
         return can["version"]
 
@@ -416,6 +423,9 @@ class BundleStore:
             if vdir.exists():
                 shutil.rmtree(vdir)
             (self.root / _CANARY).unlink(missing_ok=True)
+            _trace.emit("swap", "pointer_flip", op="rollback",
+                        pointer=_CANARY, version=can["version"],
+                        digest=can["digest"], reason=reason)
         cdir = self.current_dir()
         if cdir is not None:
             manifest, arrays = self._read_current()
@@ -567,6 +577,8 @@ class BundleStore:
             (self.root / _CANARY).unlink(missing_ok=True)
         atomic_write_json(self.root / _CURRENT,
                           {"version": version, "digest": manifest["digest"]})
+        _trace.emit("swap", "pointer_flip", op="recover", pointer=_CURRENT,
+                    version=version, digest=manifest["digest"])
         self.gc_versions()
         return version
 

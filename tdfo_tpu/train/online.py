@@ -74,6 +74,8 @@ from typing import Any
 
 import numpy as np
 
+from tdfo_tpu.obs import trace as _trace
+from tdfo_tpu.obs.aggregate import percentile as _percentile
 from tdfo_tpu.utils import faults as _faults
 
 __all__ = ["OnlineLoop", "online_from_config"]
@@ -85,6 +87,30 @@ def _stage(name: str) -> None:
     inj = _faults.active()
     if inj is not None:
         inj.maybe_kill_stage(name)
+
+
+class _StageTrace:
+    """Per-cycle stage timer: ``mark(name)`` closes the previous stage's
+    trace span and opens the next, so the assembled timeline gets a
+    wall-clock breakdown of every stage the cycle actually crossed.  A
+    killed stage simply never closes — its partial time is lost with the
+    cycle (which redoes entirely anyway)."""
+
+    def __init__(self, cycle: int):
+        self.cycle = int(cycle)
+        self._name: str | None = None
+        self._t0 = 0.0
+
+    def mark(self, name: str) -> None:
+        self.close()
+        self._name, self._t0 = name, _trace.clock()
+
+    def close(self) -> None:
+        if self._name is not None:
+            _trace.emit("online", "stage", cycle=self.cycle,
+                        stage=self._name,
+                        dur_ms=round(_trace.elapsed_ms(self._t0), 3))
+            self._name = None
 
 
 class OnlineLoop:
@@ -324,7 +350,11 @@ class OnlineLoop:
         """One full serve->retrain->swap cycle; ``None`` when the durable
         log has fewer than one batch of unread rows (drained)."""
         cfg = self.config
+        st = _StageTrace(self.cycles)  # metrics rec numbers ungated cycles 0-based
+        cycle_t0 = _trace.clock()
+        step_begin = self.gstep
         _stage("replay")
+        st.mark("replay")
         self.consumer.check_backpressure()
         batches, consumed = [], []
         while len(batches) < cfg.online.steps_per_cycle:
@@ -337,9 +367,11 @@ class OnlineLoop:
             return None
 
         _stage("train")
+        st.mark("train")
         loss = self._train_cycle(batches)
 
         _stage("checkpoint")
+        st.mark("checkpoint")
         target = int(self.store.current_version() or 0) + 1
         self.trainer._ckpt.save(
             self.gstep, self.trainer.state, force=True,
@@ -348,6 +380,14 @@ class OnlineLoop:
                     "target_version": target},
             stamps=self.trainer._ckpt_stamps)
         self._claimed_version = target
+        # ungated cycles have no verdict; "published" marks the direct-to-
+        # CURRENT path in the assembled timeline
+        _trace.emit(
+            "online", "online_cycle", cycle=self.cycles,
+            verdict="published", version=target,
+            step_begin=step_begin, step_end=self.gstep,
+            dur_ms=round(_trace.elapsed_ms(cycle_t0), 3),
+            consumed=[list(span) for span in consumed])
         rec = {
             "event": "online_cycle", "cycle": self.cycles,
             "global_step": self.gstep, "steps": len(batches),
@@ -357,9 +397,11 @@ class OnlineLoop:
         }
         self.trainer.logger.log(**rec)
 
+        st.mark("publish")
         self._publish_state(target)  # stages: export -> publish
 
         _stage("swap")
+        st.mark("swap")
         if self.fleet is not None:
             # ungated fleet: every replica follows the freshly-moved CURRENT
             self.fleet.sync()
@@ -367,6 +409,7 @@ class OnlineLoop:
             scorer = self._build_scorer(self.store.current_dir())
             self.batcher.swap(scorer.score, version=target,
                               program_cache_size=scorer.score_cache_size)
+        st.close()
         self.cycles += 1
         return rec
 
@@ -421,8 +464,12 @@ class OnlineLoop:
         cfg = self.config
         inj = _faults.active()
         cycle_no = self.cycles_done + 1
+        st = _StageTrace(cycle_no)
+        cycle_t0 = _trace.clock()
+        step_begin = self.gstep
 
         _stage("replay")
+        st.mark("replay")
         self.consumer.check_backpressure()
         batches, consumed = [], []
         while len(batches) < cfg.online.steps_per_cycle:
@@ -443,9 +490,11 @@ class OnlineLoop:
                         for k in shadow[0] if k != "label"}
 
         _stage("train")
+        st.mark("train")
         loss = self._train_cycle(batches)
 
         _stage("export")
+        st.mark("export")
         target = int(self.store.current_version() or 0) + 1
         delta_dir = self.chain / _version_name(target)
         if delta_dir.exists():
@@ -481,6 +530,9 @@ class OnlineLoop:
 
         verdict, reason = "promote", ""
         canary_auc = stable_auc = None
+        canary_p99 = stable_p99 = None
+        canary_ms: list[float] = []
+        stable_ms: list[float] = []
         if auc_cand < auc_base - cfg.online.max_auc_regression:
             verdict = "rejected"
             reason = (f"shadow gate: candidate AUC {auc_cand:.4f} < "
@@ -492,9 +544,16 @@ class OnlineLoop:
                 # gate scored them directly and passed) — only live serving
                 # misbehaves, which is what the canary watch exists for
                 self.fleet.set_score_skew(digest)
+            if inj is not None and inj.slow_canary_due(cycle_no):
+                # latency regression the AUC gate cannot see: only the
+                # replicas serving this digest score slowly, so the p99
+                # verdict term below has a differential signal
+                self.fleet.set_score_slow(digest)
             _stage("publish")
+            st.mark("publish")
             self.store.publish_canary(delta_dir, composed=(manifest, arrays))
             _stage("canary")
+            st.mark("canary")
             self.fleet.sync()  # the canary cohort picks the candidate up
             for rnd in range(1, cfg.online.canary_cycles + 1):
                 if inj is not None:
@@ -511,6 +570,8 @@ class OnlineLoop:
                 if not canaries:
                     verdict, reason = "rollback", "no alive canary replica"
                     break
+                canary_ms.extend(h["ms"] for h in canaries)
+                stable_ms.extend(h["ms"] for h in stables)
                 canary_auc = float(np.mean([h["auc"] for h in canaries]))
                 stable_auc = (float(np.mean([h["auc"] for h in stables]))
                               if stables else auc_base)
@@ -521,8 +582,24 @@ class OnlineLoop:
                               f"{cfg.online.max_auc_regression} at watch "
                               f"round {rnd}")
                     break
+            # latency verdict term ([online] max_p99_regression_ms): the
+            # heartbeat-scoring p99s, canary cohort vs stable cohort, on
+            # the SAME nearest-rank percentile launch.py obs reports — a
+            # candidate that serves correct logits slowly rolls back
+            # exactly like an AUC regression
+            canary_p99 = _percentile(canary_ms, 99)
+            stable_p99 = _percentile(stable_ms, 99)
+            if (verdict == "promote" and cfg.online.max_p99_regression_ms > 0
+                    and canary_p99 is not None and stable_p99 is not None
+                    and canary_p99 > stable_p99
+                    + cfg.online.max_p99_regression_ms):
+                verdict = "rollback"
+                reason = (f"canary p99 {canary_p99:.1f}ms > stable p99 "
+                          f"{stable_p99:.1f}ms + "
+                          f"{cfg.online.max_p99_regression_ms}ms budget")
 
         _stage("verdict")
+        st.mark("verdict")
         if verdict != "promote":
             self._restore_last_good()
         canary_rec = {"verdict": verdict, "version": target,
@@ -537,18 +614,34 @@ class OnlineLoop:
                     "canary": canary_rec},
             stamps=self.trainer._ckpt_stamps)
         self._pending_canary = canary_rec
+        # the cycle's trace span lands right AFTER its single durability
+        # point: a kill before the verdict checkpoint redoes the cycle (and
+        # emits then, once); a kill after it leaves the span already on
+        # disk while _catch_up_gated replays the store side — either way
+        # the assembled timeline carries exactly one record per durable
+        # cycle (obs/aggregate.py dedups by cycle number, last wins)
+        _trace.emit(
+            "online", "online_cycle", cycle=cycle_no, verdict=verdict,
+            reason=reason, version=target, digest=digest,
+            step_begin=step_begin, step_end=self.gstep,
+            canary_p99_ms=canary_p99, stable_p99_ms=stable_p99,
+            dur_ms=round(_trace.elapsed_ms(cycle_t0), 3),
+            consumed=[list(span) for span in consumed])
 
         _stage("commit")
+        st.mark("commit")
         if verdict == "promote":
             self.store.promote_canary()
         elif verdict == "rollback":
             self.store.rollback_canary(reason)
 
         _stage("swap")
+        st.mark("swap")
         self.fleet.sync()  # every replica converges on the verdict's head
         if cfg.online.keep_consumed_segments > 0:
             self.consumer.gc_consumed_segments(
                 cfg.online.keep_consumed_segments)
+        st.close()
         self.cycles_done = cycle_no
         self.cycles += 1
         rec = {
@@ -557,6 +650,7 @@ class OnlineLoop:
             "verdict": verdict, "reason": reason, "version": target,
             "shadow_auc": auc_cand, "shadow_auc_base": auc_base,
             "canary_auc": canary_auc, "stable_auc": stable_auc,
+            "canary_p99_ms": canary_p99, "stable_p99_ms": stable_p99,
             "consumed": [list(span) for span in consumed],
             **self.consumer.counters(),
         }

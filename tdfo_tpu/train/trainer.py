@@ -48,6 +48,7 @@ from tdfo_tpu.core.config import Config
 from tdfo_tpu.core.mesh import make_mesh
 from tdfo_tpu.obs import counters as obs_counters
 from tdfo_tpu.obs import events as obs_events
+from tdfo_tpu.obs import trace as obs_trace
 from tdfo_tpu.data.loader import (
     MapStream,
     ParquetStream,
@@ -463,18 +464,24 @@ class Trainer:
         self._flush_ctrs: dict = {}  # latest cache-flush counter fetch
         self._a2a_fill = None  # alltoall bucket-utilisation probe (jitted)
         self._watchdog = None
-        if (tele.events or tele.stall_timeout_s > 0) and not out_dir:
+        if (tele.events or tele.stall_timeout_s > 0 or tele.trace) \
+                and not out_dir:
             raise ValueError(
-                "telemetry.events / telemetry.stall_timeout_s need a "
-                "checkpoint_dir (or log_dir) to write events.jsonl / "
-                "heartbeat.jsonl")
+                "telemetry.events / telemetry.stall_timeout_s / "
+                "telemetry.trace need a checkpoint_dir (or log_dir) to "
+                "write events.jsonl / heartbeat.jsonl / trace-*.jsonl")
         if tele.events and jax.process_index() == 0:
-            obs_events.configure(Path(out_dir) / "events.jsonl")
+            obs_events.configure(Path(out_dir) / "events.jsonl",
+                                 rotate_bytes=tele.log_rotate_bytes)
+        if tele.trace and jax.process_index() == 0:
+            obs_trace.configure(Path(out_dir) / "trace",
+                                rotate_bytes=tele.log_rotate_bytes)
         if tele.stall_timeout_s > 0 and jax.process_index() == 0:
             from tdfo_tpu.obs.watchdog import StallWatchdog
 
             self._watchdog = StallWatchdog(
-                Path(out_dir) / "heartbeat.jsonl", tele.stall_timeout_s)
+                Path(out_dir) / "heartbeat.jsonl", tele.stall_timeout_s,
+                rotate_bytes=tele.log_rotate_bytes)
         if config.checkpoint_dir:
             from tdfo_tpu.train.checkpoint import CheckpointManager
 
@@ -1143,10 +1150,11 @@ class Trainer:
         """
         cfg = self.config
         inj = _faults.active()
-        # monotonic: host-loop wall time (throughput), the one sanctioned
-        # wall-clock differencing outside bench.chain_time — time.time /
-        # perf_counter differencing is rejected by tests/test_quality.py
-        t0 = time.monotonic()
+        # host-loop wall time (throughput) via obs.trace's clock helpers —
+        # the single sanctioned monotonic-differencing site (time.time /
+        # perf_counter / raw monotonic differencing is rejected by
+        # tests/test_quality.py)
+        t0 = obs_trace.clock()
         n_steps = start_step
         step_ctrs: dict = {}  # latest step's device counter pytree
         # update-cache write-back schedule: the periodic flush runs async
@@ -1354,7 +1362,7 @@ class Trainer:
                 jax.profiler.stop_trace()
         flush_checks()
         self._flush_cache_sync()  # epoch boundary: leave the tables flushed
-        dt = time.monotonic() - t0
+        dt = obs_trace.elapsed_s(t0)
         ran = n_steps - start_step  # steps actually executed THIS session
         self._logged_steps += n_steps
         avg = loss_sum / contributed if contributed else 0.0
@@ -1607,6 +1615,8 @@ class Trainer:
                 obs_events.record("run_summary",
                                   peak_bytes=obs_events.peak_memory())
                 obs_events.configure(None)
+            if obs_trace.active():
+                obs_trace.configure(None)
             self.logger.close()
             if self._ckpt is not None:
                 self._ckpt.close()

@@ -86,6 +86,13 @@ and ``tdfo_tpu/serve/fleet.py``) drive the fleet rollout state machine:
     (the replica stops syncing/serving; NO ``os._exit`` — the supervisor
     process survives), re-fired deterministically on every restart so
     killed and uninterrupted lineages see the same fleet membership.
+  * ``slow_canary_at_cycle = N`` (+ ``slow_score_ms = M``)  — the candidate
+    of gated cycle N scores slowly ON THE REPLICAS THAT LOAD IT (the fleet
+    wraps that digest's scorer in an M-ms host sleep): a latency
+    regression the AUC gate cannot see, driving the
+    ``[online] max_p99_regression_ms`` verdict term.  Pure compare on the
+    DURABLE cycle number, like ``regress_auc_at_cycle``, so restarted
+    redos re-inject identically.  The stable cohort is untouched.
 
 All training triggers key on run-global DATA position (batches consumed),
 which is monotone across rollbacks and resumes — ``state.step`` is not
@@ -134,6 +141,7 @@ class FaultSpec:
     regress_auc_at_cycle: int = 0
     kill_during_canary: int = 0
     kill_replica_nth: int = 0
+    slow_canary_at_cycle: int = 0
 
     def __post_init__(self) -> None:
         for name in ("kill_at_step", "nan_at_step", "fail_io_nth",
@@ -143,7 +151,7 @@ class FaultSpec:
                      "corrupt_record_nth", "kill_during_replay",
                      "kill_between_stages", "corrupt_candidate",
                      "regress_auc_at_cycle", "kill_during_canary",
-                     "kill_replica_nth"):
+                     "kill_replica_nth", "slow_canary_at_cycle"):
             if getattr(self, name) < 0:
                 raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
 
@@ -155,7 +163,8 @@ class FaultSpec:
                     or self.dup_record_nth or self.corrupt_record_nth
                     or self.kill_during_replay or self.kill_between_stages
                     or self.corrupt_candidate or self.regress_auc_at_cycle
-                    or self.kill_during_canary or self.kill_replica_nth)
+                    or self.kill_during_canary or self.kill_replica_nth
+                    or self.slow_canary_at_cycle)
 
 
 class FaultInjector:
@@ -262,7 +271,19 @@ class FaultInjector:
 
     def maybe_slow_score(self) -> None:
         """Sleep ``slow_score_ms`` on every shipped scoring batch — a
-        deterministic wedged-scorer stand-in for the serving heartbeat."""
+        deterministic wedged-scorer stand-in for the serving heartbeat.
+        When ``slow_canary_at_cycle`` is ALSO set the knob is claimed by
+        the digest-keyed canary slowdown (:meth:`slow_score_sleep` via the
+        fleet's slow-scorer wrap) and this fleet-wide path stays fast —
+        the latency regression must be differential or the p99 verdict
+        term has nothing to compare."""
+        if self.spec.slow_score_ms and not self.spec.slow_canary_at_cycle:
+            time.sleep(self.spec.slow_score_ms / 1000.0)
+
+    def slow_score_sleep(self) -> None:
+        """Unconditional ``slow_score_ms`` sleep — called only from the
+        fleet's digest-keyed slow-scorer wrap (``slow_canary_at_cycle``),
+        which already decided THIS scorer is the slow one."""
         if self.spec.slow_score_ms:
             time.sleep(self.spec.slow_score_ms / 1000.0)
 
@@ -402,6 +423,15 @@ class FaultInjector:
         re-injects the identical regression."""
         return bool(self.spec.regress_auc_at_cycle
                     and cycle == self.spec.regress_auc_at_cycle)
+
+    def slow_canary_due(self, cycle: int) -> bool:
+        """True when the candidate of gated cycle ``cycle`` should score
+        slowly on the replicas that load it (``slow_score_ms`` per shipped
+        batch) — the latency twin of ``auc_regress_due``: same pure compare
+        on the durable cycle number, same restart determinism."""
+        return bool(self.spec.slow_canary_at_cycle
+                    and self.spec.slow_score_ms
+                    and cycle == self.spec.slow_canary_at_cycle)
 
     def canary_kill_due(self, rnd: int) -> bool:
         """True when the mid-canary kill should fire on THIS watch round

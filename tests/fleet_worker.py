@@ -8,10 +8,12 @@ Spec keys: ``data_dir``, ``checkpoint_dir``, ``log_dir``, ``request_log``
 (a fleet layout — ``replica-<k>`` subdirectories), ``out_json``,
 ``local_devices``, ``steps_per_cycle``, ``max_cycles``, ``replicas``,
 ``canary_cycles``, ``canary_fraction``, ``max_auc_regression``,
-``shadow_eval_batches``, ``keep_versions``, ``keep_consumed_segments``,
-``faults`` (a ``[faults]`` dict — regress_auc_at_cycle /
-kill_during_canary / kill_replica_nth / corrupt_candidate /
-kill_between_stages / kill_during_swap), ``probe_seed``.
+``max_p99_regression_ms``, ``shadow_eval_batches``, ``keep_versions``,
+``keep_consumed_segments``, ``telemetry`` (a ``[telemetry]`` dict — trace
+/ log_rotate_bytes), ``faults`` (a ``[faults]`` dict —
+regress_auc_at_cycle / kill_during_canary / kill_replica_nth /
+corrupt_candidate / kill_between_stages / kill_during_swap /
+slow_canary_at_cycle + slow_score_ms), ``probe_seed``.
 
 Spoofs CPU devices and runs the REAL gated ``OnlineLoop``
 (``train/online.py`` with ``[online] canary_cycles > 0``) over a
@@ -64,6 +66,7 @@ def main() -> None:
         size_map=load_size_map(spec["data_dir"]),
         checkpoint_dir=spec["checkpoint_dir"],
         faults=dict(spec.get("faults") or {}),
+        telemetry=dict(spec.get("telemetry") or {}),
         serving=dict(
             replicas=int(spec.get("replicas", 2)),
             keep_versions=int(spec.get("keep_versions", 0)),
@@ -75,6 +78,8 @@ def main() -> None:
             canary_cycles=int(spec.get("canary_cycles", 1)),
             canary_fraction=float(spec.get("canary_fraction", 0.5)),
             max_auc_regression=float(spec.get("max_auc_regression", 0.3)),
+            max_p99_regression_ms=float(
+                spec.get("max_p99_regression_ms", 0.0)),
             shadow_eval_batches=int(spec.get("shadow_eval_batches", 1)),
             keep_consumed_segments=int(
                 spec.get("keep_consumed_segments", 0)),

@@ -667,3 +667,35 @@ def test_fleet_fault_triggers_table(tmp_path: Path):
     assert cfg.faults.kill_during_canary == 3
     assert cfg.faults.kill_replica_nth == 4
     assert cfg.faults.any()
+
+
+def test_trace_and_latency_gate_knobs(tmp_path: Path):
+    """PR-15 knobs: [telemetry] trace, [online] max_p99_regression_ms and
+    the [faults] slow_canary_at_cycle trigger — defaults, toml round-trip,
+    rejection, and injector arming."""
+    from tdfo_tpu.core.config import OnlineSpec
+
+    cfg = read_configs()
+    assert cfg.telemetry.trace is False  # off by default: tracing is free
+    assert cfg.online.max_p99_regression_ms == 0.0  # latency gate disabled
+
+    (tmp_path / "config.toml").write_text(
+        "checkpoint_dir = \"ckpt\"\n"
+        "[telemetry]\ntrace = true\n"
+        "[serving]\nreplicas = 4\n"
+        "[online]\nrequest_log = \"rl\"\ncanary_cycles = 2\n"
+        "max_p99_regression_ms = 75.0\n"
+        "[faults]\nslow_canary_at_cycle = 1\nslow_score_ms = 200\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.telemetry.trace is True
+    assert cfg.online.max_p99_regression_ms == 75.0
+    assert cfg.faults.slow_canary_at_cycle == 1
+    assert cfg.faults.slow_score_ms == 200
+    assert cfg.faults.any()
+    from tdfo_tpu.utils.faults import FaultInjector
+
+    inj = FaultInjector(cfg.faults)
+    assert inj.slow_canary_due(1) and not inj.slow_canary_due(2)
+
+    with pytest.raises(ValueError, match="max_p99_regression_ms"):
+        Config(online=OnlineSpec(max_p99_regression_ms=-1.0))

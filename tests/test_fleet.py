@@ -148,24 +148,37 @@ def fleet_runs(fleet_env, tmp_path_factory):
       * ``killdrill`` — the same regression PLUS ``kill_during_canary=1``:
         dies mid-watch with the candidate on the canary cohort and no
         durable verdict, then restarts the same command.
+      * ``p99drill`` — ``slow_canary_at_cycle=1`` + ``slow_score_ms``:
+        cycle 1's candidate serves CORRECT logits slowly; the
+        ``max_p99_regression_ms`` verdict term must roll it back while the
+        stable cohort's latency never regresses.
+
+    All three run with ``[telemetry] trace = true`` so the assembled
+    causal timelines are audited against the metrics ground truth.
     """
     from tdfo_tpu.utils.faults import KILL_EXIT_CODE
 
     tmp = tmp_path_factory.mktemp("fleet_runs")
     drill_p = _make_spec(tmp, fleet_env, "drill", ckpt="ckpt_drill",
-                         log="log_drill",
+                         log="log_drill", telemetry={"trace": True},
                          faults={"regress_auc_at_cycle": 1})
     kill_p = _make_spec(tmp, fleet_env, "killdrill", ckpt="ckpt_kill",
-                        log="log_kill",
+                        log="log_kill", telemetry={"trace": True},
                         faults={"regress_auc_at_cycle": 1,
                                 "kill_during_canary": 1})
+    p99_p = _make_spec(tmp, fleet_env, "p99drill", ckpt="ckpt_p99",
+                       log="log_p99", telemetry={"trace": True},
+                       max_p99_regression_ms=100.0,
+                       faults={"slow_canary_at_cycle": 1,
+                               "slow_score_ms": 400})
 
-    rcs, outs = _run_workers([drill_p, kill_p])
+    rcs, outs = _run_workers([drill_p, kill_p, p99_p])
     assert rcs[0] == 0, f"drill run failed rc={rcs[0]}\n{outs[0][-2000:]}"
     assert rcs[1] == KILL_EXIT_CODE, \
         f"expected mid-canary kill, got rc={rcs[1]}\n{outs[1][-2000:]}"
     assert not (tmp / "killdrill.json").exists()  # died before any verdict
     assert (tmp / "ckpt_kill" / "faults_canary_kill.marker").exists()
+    assert rcs[2] == 0, f"p99 drill failed rc={rcs[2]}\n{outs[2][-2000:]}"
 
     rc, out = _run_worker(kill_p)  # marker disarms the kill; redo the cycle
     assert rc == 0, f"resumed killdrill failed rc={rc}\n{out[-2000:]}"
@@ -173,7 +186,12 @@ def fleet_runs(fleet_env, tmp_path_factory):
     return dict(
         drill=json.loads((tmp / "drill.json").read_text()),
         killdrill=json.loads((tmp / "killdrill.json").read_text()),
+        p99drill=json.loads((tmp / "p99drill.json").read_text()),
         drill_metrics=tmp / "log_drill" / "metrics.jsonl",
+        p99_metrics=tmp / "log_p99" / "metrics.jsonl",
+        drill_trace=tmp / "log_drill" / "trace",
+        kill_trace=tmp / "log_kill" / "trace",
+        p99_trace=tmp / "log_p99" / "trace",
         tmp=tmp,
     )
 
@@ -273,6 +291,98 @@ def test_merged_replay_exactly_once_accounting(fleet_runs, fleet_env):
         assert parts[-1][1] <= rows_by_key[key]
     # both replicas' logs contributed to training — the merger merges
     assert {k[0] for k in spans} == set(range(N_REPLICAS))
+
+
+def test_p99_regression_rolls_back_and_stable_never_regresses(fleet_runs):
+    """The latency twin of the AUC drill: cycle 1's candidate serves
+    correct logits slowly (``slow_canary_at_cycle``), passes the shadow
+    and AUC gates, and is rolled back by the ``max_p99_regression_ms``
+    verdict term — while the stable cohort's heartbeat p99 stays far under
+    the budget.  Cycle 2's honest candidate promotes."""
+    cycles = _events(fleet_runs["p99_metrics"], "online_cycle")
+    assert [c["verdict"] for c in cycles] == ["rollback", "promote"]
+    bad = cycles[0]
+    # the AUC gates saw nothing wrong — the logits are correct
+    assert bad["shadow_auc"] >= bad["shadow_auc_base"] - 0.3
+    assert bad["canary_auc"] >= bad["stable_auc"] - 0.3
+    # the latency term caught it: canary p99 carries the injected sleep,
+    # the stable cohort never slowed down
+    assert "p99" in bad["reason"]
+    assert bad["canary_p99_ms"] > bad["stable_p99_ms"] + 100.0
+    assert bad["stable_p99_ms"] < 100.0
+    # ledgered exactly like an AUC rejection; cycle 2 reuses the version
+    res = fleet_runs["p99drill"]
+    assert len(res["rejections"]) == 1 and res["rejections"][0]["version"] == 1
+    assert res["version"] == 1 and res["canary_version"] is None
+    assert set(res["replica_versions"].values()) == {1}
+    good = cycles[1]
+    assert good["canary_p99_ms"] is not None  # measured, under budget
+    assert not good["reason"]
+
+
+def test_trace_assembly_reconstructs_drill(fleet_runs):
+    """The assembled causal timeline agrees with the metrics ground truth:
+    per-cycle verdicts/versions, per-stage breakdowns for the full gated
+    chain, cohort-split heartbeat histograms, and the pointer-flip ledger
+    (canary -> rollback, canary -> promote)."""
+    from tdfo_tpu.obs.aggregate import assemble, chrome_trace, load_spans
+
+    spans = load_spans(fleet_runs["drill_trace"])
+    assert spans, "trace=true produced no spans"
+    report = assemble(spans)
+    metrics = _events(fleet_runs["drill_metrics"], "online_cycle")
+    assert [c["cycle"] for c in report["cycles"]] == [1, 2]
+    for traced, logged in zip(report["cycles"], metrics):
+        assert traced["verdict"] == logged["verdict"]
+        assert traced["version"] == logged["version"]
+        # the span's consumed ranges are the metrics record's, verbatim —
+        # the exactly-once row audit above therefore covers the trace too
+        assert traced["consumed_keys"] == sorted(
+            {(rid, seq) for rid, seq, _, _ in logged["consumed"]})
+        assert set(traced["stages"]) >= {"replay", "train", "verdict",
+                                         "commit", "swap"}
+        assert traced["dur_ms"] > 0
+        assert traced["steps"][1] - traced["steps"][0] == STEPS_PER_CYCLE
+    fl = report["fleet"]
+    assert fl["canary_heartbeats"]["n"] > 0
+    assert fl["stable_heartbeats"]["n"] > 0
+    ops = [f["op"] for f in report["pointer_flips"]]
+    assert ops.count("canary") == N_CYCLES  # one candidate staged per cycle
+    assert "rollback" in ops and "promote" in ops
+    # the chrome export of a real run serializes end to end
+    json.dumps(chrome_trace(spans))
+
+
+def test_trace_killdrill_assembles_exactly_once(fleet_runs):
+    """The acceptance bar: the killed-and-restarted run's sinks hold
+    partial spans from BOTH lineages, yet the assembled timeline
+    reconstructs every cycle exactly once and converges to the
+    uninterrupted drill — cycle spans land only at the verdict durability
+    point, and the assembler keeps the last durable emission per cycle."""
+    from tdfo_tpu.obs.aggregate import assemble, load_spans
+
+    kd = assemble(load_spans(fleet_runs["kill_trace"]))
+    drill = assemble(load_spans(fleet_runs["drill_trace"]))
+    assert [c["cycle"] for c in kd["cycles"]] == [1, 2]  # no dup, no gap
+    for k, d in zip(kd["cycles"], drill["cycles"]):
+        assert k["verdict"] == d["verdict"]
+        assert k["version"] == d["version"]
+        assert k["consumed_keys"] == d["consumed_keys"]
+    # row-level exactly-once from the TRACE spans alone: the per-key
+    # ranges across cycles tile contiguously from 0 with no overlap
+    ranges: dict[tuple[int, int], list[tuple[int, int]]] = {}
+    kd_cycle_spans = [s for s in load_spans(fleet_runs["kill_trace"])
+                      if s.get("kind") == "online_cycle"]
+    by_cycle = {int(s["cycle"]): s for s in kd_cycle_spans}  # last wins
+    for s in by_cycle.values():
+        for rid, seq, a, b in s["consumed"]:
+            ranges.setdefault((rid, seq), []).append((a, b))
+    assert ranges
+    for key, parts in ranges.items():
+        parts.sort()
+        assert parts[0][0] == 0, (key, parts)
+        for (_, b0), (a1, _) in zip(parts, parts[1:]):
+            assert b0 == a1, f"{key}: gap or overlap at {parts}"
 
 
 # --------------------------------------------------------------------------

@@ -212,6 +212,121 @@ def test_no_wall_clock_differencing_around_device_work():
         "time): " + ", ".join(offenders))
 
 
+def test_monotonic_differencing_and_id_minting_confined_to_trace_module():
+    """``obs/trace.py`` is the single sanctioned home for host-loop
+    interval timing (``clock()``/``elapsed_ms()``/``elapsed_s()``) and for
+    span-id minting (a locked deterministic counter).  Two sub-rules:
+
+      * no ``time.monotonic()`` CALL, and no subtraction involving one (or
+        a name bound from one, or from ``trace.clock()``), outside
+        obs/trace.py — every wall-time measurement flows through one
+        auditable site.  Injectable-clock ATTRIBUTE calls
+        (``self._clock()``, the watchdog/frontend deadline machinery) and
+        bare ``time.monotonic`` references passed as defaults stay legal:
+        they are the test seam, not a timing fork.
+      * no ``uuid``/``secrets`` import anywhere in the package or bench
+        drivers — random ids would break restart determinism, and the
+        causal join keys are domain ids (replica, seq, cycle, version),
+        so nothing ever needs one.
+
+    Self-tested on synthetic offenders."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    files = sorted(root.rglob("*.py")) + sorted(root.parent.glob("bench*.py"))
+    SANCTIONED = "obs/trace.py"
+
+    def is_mono_call(node):
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "monotonic"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "time")
+
+    def is_trace_clock_call(node):
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "clock"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("trace", "_trace", "obs_trace"))
+
+    def scan(tree):
+        """-> (mono_call_lines, sub_lines, mint_lines)"""
+        mono, subs, mints = [], [], []
+        parents = {}
+        for node in ast.walk(tree):
+            for ch in ast.iter_child_nodes(node):
+                parents[ch] = node
+
+        def enclosing_fn(node):
+            while node in parents:
+                node = parents[node]
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    return node
+            return None
+
+        # taint is FUNCTION-scoped: an unrelated `t0` in another function
+        # (e.g. an injectable-clock deadline) must not inherit it
+        tainted = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and (
+                    is_mono_call(node.value) or is_trace_clock_call(node.value)):
+                fn = enclosing_fn(node)
+                tainted.update((t.id, fn) for t in node.targets
+                               if isinstance(t, ast.Name))
+        for node in ast.walk(tree):
+            if is_mono_call(node):
+                mono.append(node.lineno)
+            if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+                    and any(is_mono_call(s) or is_trace_clock_call(s)
+                            or (isinstance(s, ast.Name)
+                                and (s.id, enclosing_fn(node)) in tainted)
+                            for s in (node.left, node.right))):
+                subs.append(node.lineno)
+            if isinstance(node, ast.Import):
+                mints += [node.lineno for a in node.names
+                          if a.name.split(".")[0] in ("uuid", "secrets")]
+            if (isinstance(node, ast.ImportFrom) and node.module
+                    and node.module.split(".")[0] in ("uuid", "secrets")):
+                mints.append(node.lineno)
+        return sorted(set(mono)), sorted(set(subs)), sorted(set(mints))
+
+    synthetic = (
+        "import time, uuid\n"
+        "from tdfo_tpu.obs import trace\n"
+        "def span(trace_id=None):\n"
+        "    t0 = time.monotonic()\n"
+        "    work()\n"
+        "    dur = time.monotonic() - t0\n"
+        "    tid = trace_id or str(uuid.uuid4())\n"
+        "    t1 = trace.clock()\n"
+        "    return dur, tid, trace.clock() - t1\n")
+    m, s, i = scan(ast.parse(synthetic))
+    assert m == [4, 6] and s == [6, 9] and i == [1]
+
+    offenders, sanctioned_hits = [], 0
+    for path in files:
+        rel = str(path.relative_to(root)) if root in path.parents else path.name
+        mono, subs, mints = scan(ast.parse(path.read_text(),
+                                           filename=str(path)))
+        if rel == SANCTIONED:
+            assert not mints  # the sanctioned timer never mints random ids
+            sanctioned_hits += len(mono) + len(subs)
+            continue
+        offenders += [f"{path}:{ln} (monotonic call/differencing)"
+                      for ln in sorted(set(mono) | set(subs))]
+        offenders += [f"{path}:{ln} (uuid/secrets import)" for ln in mints]
+    assert sanctioned_hits > 0  # the scanner sees the sanctioned site
+    assert not offenders, (
+        "monotonic-clock timing or random id minting outside obs/trace.py "
+        "— route intervals through trace.clock()/elapsed_ms() and use "
+        "domain ids (replica, seq, cycle, version) as join keys: "
+        + ", ".join(offenders))
+
+
 def test_no_cost_constants_outside_cost_model():
     """`tdfo_tpu/plan/costs.py` is the single sanctioned home for measured
     per-descriptor cost constants (the executable docs/BUDGET.md): a
@@ -480,17 +595,20 @@ def test_no_adhoc_jsonl_tailers():
     silently skips ALL of that — it would happily train on a torn or
     corrupted log.  The detector flags any ``json.loads`` call lexically
     inside a ``for``/``while`` loop in the package, outside the blessed
-    readers: ``data/replay.py`` itself and ``plan/stats.py`` (which streams
+    readers: ``data/replay.py`` itself, ``plan/stats.py`` (which streams
     its OWN stats artifact, written atomically as a complete file — not a
-    live log).  Whole-file ``json.loads(path.read_text())`` reads are
-    loop-free and stay legal.  Self-tested on a synthetic offender."""
+    live log) and ``obs/aggregate.py`` (which assembles its OWN trace
+    sinks — complete-line appends with no cursor to bypass; it skips, never
+    parses, a live writer's torn tail).  Whole-file
+    ``json.loads(path.read_text())`` reads are loop-free and stay legal.
+    Self-tested on a synthetic offender."""
     import ast
     from pathlib import Path
 
     import tdfo_tpu
 
     root = Path(tdfo_tpu.__file__).parent
-    BLESSED = {"data/replay.py", "plan/stats.py"}
+    BLESSED = {"data/replay.py", "plan/stats.py", "obs/aggregate.py"}
 
     def loop_loads_lines(tree):
         hits = []

@@ -1,0 +1,381 @@
+"""Causal tracing (``[telemetry] trace``): sinks, assembly, zero-cost pin.
+
+The tentpole contracts (``tdfo_tpu/obs/trace.py`` + ``obs/aggregate.py``):
+
+  * **Off is free** — unconfigured ``emit``/``span`` touch no files, and a
+    traced train step's jaxpr is BYTE-identical with tracing on: spans are
+    host-side emits at serve/replay/cycle boundaries, nothing rides the
+    step program.
+  * **Sinks are crash-safe JSONL** — one complete line per append, rotated
+    through the shared ``utils/logrotate`` machinery; the assembler skips
+    (never guesses at) a torn tail.
+  * **Ids join causally** — a served request's ``(replica, seq)`` flows
+    from the frontend span through the replay batch into the online-cycle
+    span; ``assemble`` reconstructs the chain, computes freshness lag from
+    the only cross-process clock (wall ``ts``), and dedups cycle spans by
+    cycle number so a killed-and-redone cycle assembles exactly once.
+
+The multi-process version of the exactly-once audit (kill-drill fleet runs)
+lives in tests/test_fleet.py; this file owns the single-process semantics.
+"""
+
+import dataclasses
+import json
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tdfo_tpu.obs import trace
+from tdfo_tpu.obs.aggregate import (assemble, chrome_trace, format_report,
+                                    load_spans, percentile)
+
+SCHEMA = {"x": (np.int32, ()), "y": (np.float32, ()),
+          "label": (np.int8, ())}
+
+
+@pytest.fixture(autouse=True)
+def _detach_trace():
+    yield
+    trace.configure(None)
+
+
+# ------------------------------------------------------------ sink basics
+
+
+def test_emit_off_is_noop(tmp_path):
+    assert not trace.active()
+    trace.emit("frontend", "serve_request", seq=1)
+    with trace.span("online", "stage", cycle=1) as extra:
+        extra["verdict"] = "promote"
+    assert list(tmp_path.iterdir()) == []  # nothing anywhere
+    assert load_spans(tmp_path) == []
+
+
+def test_emit_writes_complete_lines_and_load_spans_orders(tmp_path):
+    trace.configure(tmp_path)
+    trace.emit("frontend", "serve_request", replica=0, seq=1)
+    trace.emit("replay", "replay_batch", rows=4)
+    trace.emit("frontend", "serve_request", replica=0, seq=2)
+    spans = load_spans(tmp_path)
+    assert [s["span"] for s in spans] == [1, 2, 3]  # ts+id order
+    assert (tmp_path / "trace-frontend.jsonl").exists()
+    assert (tmp_path / "trace-replay.jsonl").exists()
+    for p in tmp_path.glob("trace-*.jsonl"):
+        for line in p.read_text().splitlines():
+            json.loads(line)  # every line complete
+
+
+def test_trace_sink_rotates_at_size(tmp_path):
+    trace.configure(tmp_path, rotate_bytes=400)
+    for i in range(40):
+        trace.emit("frontend", "serve_request", replica=0, seq=i)
+    main = tmp_path / "trace-frontend.jsonl"
+    overflow = tmp_path / "trace-frontend.jsonl.1"
+    assert overflow.exists()
+    # the live file is bounded (absent right after a rotation, until the
+    # next emit recreates it — the retries.jsonl shape)
+    if main.exists():
+        assert main.stat().st_size < 400 + 200
+    # one generation of history is the contract: the survivors are a
+    # contiguous, complete, ordered SUFFIX of the emitted spans
+    seqs = [s["seq"] for s in load_spans(tmp_path)]
+    assert seqs == list(range(seqs[0], 40))
+
+
+def test_span_ids_deterministic_across_reconfigure(tmp_path):
+    trace.configure(tmp_path / "a")
+    for i in range(3):
+        trace.emit("online", "stage", stage=f"s{i}")
+    ids_a = [s["span"] for s in load_spans(tmp_path / "a")]
+    trace.configure(tmp_path / "b")  # a restarted run
+    for i in range(3):
+        trace.emit("online", "stage", stage=f"s{i}")
+    ids_b = [s["span"] for s in load_spans(tmp_path / "b")]
+    assert ids_a == ids_b == [1, 2, 3]  # counter, never uuid/random
+
+
+def test_span_contextmanager_emits_dur_even_on_raise(tmp_path):
+    trace.configure(tmp_path)
+    with pytest.raises(RuntimeError):
+        with trace.span("online", "stage", cycle=2, stage="train") as extra:
+            extra["steps"] = 5
+            raise RuntimeError("killed mid-stage")
+    (s,) = load_spans(tmp_path)
+    assert s["kind"] == "stage" and s["stage"] == "train"
+    assert s["steps"] == 5 and s["dur_ms"] >= 0.0
+
+
+def test_load_spans_skips_torn_tail(tmp_path):
+    trace.configure(tmp_path)
+    trace.emit("replay", "replay_batch", rows=4)
+    with open(tmp_path / "trace-replay.jsonl", "a") as f:
+        f.write('{"span": 2, "ts": 1.0, "compo')  # kill mid-append
+    spans = load_spans(tmp_path)
+    assert len(spans) == 1 and spans[0]["rows"] == 4
+
+
+# ------------------------------------------------------------- percentile
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 99) is None
+    assert percentile([7.0], 50) == 7.0
+    assert percentile([1, 2, 3, 4], 50) == 2.0  # nearest-rank, not interp
+    samples = list(range(1, 101))
+    assert percentile(samples, 99) == 99
+    assert percentile(samples, 100) == 100
+    assert percentile(samples, 0) == 1
+
+
+# -------------------------------------------------------- causal assembly
+
+
+def _cycle_span(cycle, *, version, verdict="promote", consumed=(),
+                reason=None, digest="d0"):
+    trace.emit("online", "online_cycle", cycle=cycle, verdict=verdict,
+               reason=reason, version=version, digest=digest,
+               step_begin=(cycle - 1) * 4, step_end=cycle * 4,
+               dur_ms=12.5, consumed=[list(c) for c in consumed])
+
+
+def test_end_to_end_id_chain(tmp_path):
+    """Frontend serve spans -> replay batch spans -> a synthetic cycle span:
+    ``assemble`` joins them on domain ids and computes freshness lag."""
+    from tdfo_tpu.data.replay import ReplayConsumer, RequestLog
+    from tdfo_tpu.serve.frontend import MicroBatcher
+
+    trace.configure(tmp_path / "trace")
+    log = RequestLog(tmp_path / "rl")
+    mb = MicroBatcher(lambda b: np.asarray(b["x"], np.float32) * 2.0,
+                      buckets=(8,), max_batch=8, batch_deadline_ms=0.0,
+                      request_log=log)
+    for i in range(4):
+        mb.run([(f"q{i}", {
+            "x": np.arange(i * 2, i * 2 + 2, dtype=np.int32),
+            "y": np.full(2, 0.5, np.float32),
+            "label": np.ones(2, np.int8)})])
+    log.close()
+
+    c = ReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=4)
+    consumed = []
+    while (out := c.next_batch()) is not None:
+        consumed.extend(out[1])
+    _cycle_span(1, version=7, consumed=consumed)
+    # the produced version goes live on a replica (what lag is measured to)
+    trace.emit("fleet", "replica_sync", replica=0, version=7, digest="d0",
+               canary=False, skewed=False, slow=False)
+
+    report = assemble(load_spans(tmp_path / "trace"))
+    assert report["n_requests"] == 4 and report["n_replay_batches"] == 2
+    (cyc,) = report["cycles"]
+    assert cyc["verdict"] == "promote" and cyc["version"] == 7
+    # flat single-log consumer -> replica 0 join keys, matching the
+    # single frontend's spans; seqs are the log's own 1-based numbers
+    assert cyc["n_consumed_requests"] == len(cyc["consumed_keys"]) == 4
+    assert [k[1] for k in cyc["consumed_keys"]] == [1, 2, 3, 4]
+    assert cyc["freshness_lag_s"] is not None and cyc["freshness_lag_s"] >= 0
+
+
+def test_assemble_dedups_cycle_spans_last_wins(tmp_path):
+    """A killed cycle is redone after restart and emits its span again —
+    exactly-once accounting keeps the LAST (durable) emission."""
+    trace.configure(tmp_path)
+    _cycle_span(1, version=5, verdict="rollback", reason="auc",
+                consumed=[(0, 1, 0, 2)])
+    _cycle_span(1, version=6, verdict="promote",
+                consumed=[(0, 1, 0, 2)])  # the redo, after restart
+    _cycle_span(2, version=7, consumed=[(0, 2, 0, 2)])
+    report = assemble(load_spans(tmp_path))
+    assert [c["cycle"] for c in report["cycles"]] == [1, 2]
+    assert report["cycles"][0]["version"] == 6  # last durable emission wins
+    # consumed keys tile the request space exactly once across cycles
+    all_keys = [k for c in report["cycles"] for k in c["consumed_keys"]]
+    assert len(all_keys) == len(set(all_keys))
+
+
+def test_assemble_merges_stage_and_heartbeat_spans(tmp_path):
+    trace.configure(tmp_path)
+    for stage, ms in (("replay", 3.0), ("train", 40.0), ("canary", 9.0)):
+        trace.emit("online", "stage", cycle=1, stage=stage, dur_ms=ms)
+    _cycle_span(1, version=3, consumed=[(1, 0, 2)])
+    for i in range(10):
+        trace.emit("fleet", "heartbeat", replica=i % 2, version=3,
+                   ms=1.0 + i, canary=(i % 2 == 1), queue_depth=i,
+                   batch_fill=0.5)
+    report = assemble(load_spans(tmp_path))
+    (cyc,) = report["cycles"]
+    assert cyc["stages"] == {"replay": 3.0, "train": 40.0, "canary": 9.0}
+    fl = report["fleet"]
+    assert fl["heartbeats"]["n"] == 10
+    assert fl["canary_heartbeats"]["n"] == fl["stable_heartbeats"]["n"] == 5
+    assert fl["canary_heartbeats"]["p50_ms"] > fl["stable_heartbeats"]["p50_ms"]
+    assert fl["per_replica"][0]["last_queue_depth"] == 8
+    assert fl["per_replica"][1]["last_batch_fill"] == 0.5
+    # the console report renders every section without raising
+    text = format_report(report)
+    assert "cycle 1" in text and "replica 0" in text
+
+
+def test_peeked_batches_emit_no_replay_spans(tmp_path):
+    """Shadow-eval reads (peek_batches) are uncommitted and must not count
+    toward the exactly-once replay accounting."""
+    from tdfo_tpu.data.replay import ReplayConsumer, RequestLog
+
+    log = RequestLog(tmp_path / "rl")
+    for i in range(6):
+        log.append({"event": "serve_request", "request": f"r{i}", "rows": 2,
+                    "outcome": "ok",
+                    "features": {"x": [i * 2, i * 2 + 1], "y": [0.5, 0.5],
+                                 "label": [1, 1]}})
+    log.close()
+    trace.configure(tmp_path / "trace")
+    c = ReplayConsumer(tmp_path / "rl", schema=SCHEMA, batch_size=4)
+    assert len(c.peek_batches(2)) == 2  # held-out gate slice: no spans
+    assert load_spans(tmp_path / "trace") == []
+    assert c.next_batch() is not None  # a committed read: one span
+    (s,) = load_spans(tmp_path / "trace")
+    assert s["kind"] == "replay_batch" and s["component"] == "replay"
+
+
+def test_chrome_trace_shape(tmp_path):
+    trace.configure(tmp_path)
+    trace.emit("online", "stage", cycle=1, stage="train", dur_ms=40.0)
+    trace.emit("frontend", "serve_request", replica=2, seq=9,
+               latency_ms=1.5)
+    obj = chrome_trace(load_spans(tmp_path))
+    events = obj["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta} == {"online", "frontend"}
+    (complete,) = [e for e in events if e["ph"] == "X"]
+    assert complete["name"] == "stage:train" and complete["dur"] == 40e3
+    (instant,) = [e for e in events if e["ph"] == "i"]
+    assert instant["tid"] == 2 and instant["args"]["seq"] == 9
+    json.dumps(obj)  # the whole object must serialize
+
+
+# ----------------------------------------------- zero-cost jaxpr pin
+
+
+def test_trace_on_step_jaxpr_byte_identical(mesh8, tmp_path):
+    """``trace = true`` must add ZERO equations to the train step: spans
+    are host-side only, so the step jaxpr with a live trace sink is
+    byte-identical to the untraced build (the ``[telemetry] counters``
+    laziness pin of test_telemetry.py, applied to tracing)."""
+    from tdfo_tpu.models.dlrm import DLRMBackbone
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.parallel.embedding import (EmbeddingSpec,
+                                             ShardedEmbeddingCollection)
+    from tdfo_tpu.train.ctr import ctr_sparse_forward
+    from tdfo_tpu.train.sparse_step import (SparseTrainState,
+                                            make_sparse_train_step)
+
+    cats = ("c0", "c1")
+    sizes = {"c0": 11, "c1": 40}
+    specs = [EmbeddingSpec(c, sizes[c], 8, features=(c,), sharding="row")
+             for c in cats]
+    coll = ShardedEmbeddingCollection(specs, mesh=mesh8, stack_tables=True)
+    bb = DLRMBackbone(embed_dim=8, cat_columns=cats, cont_columns=("x0",))
+    dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in cats}
+    dummy_c = {"x0": jnp.zeros((1,), jnp.float32)}
+    state = SparseTrainState.create(
+        dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adam(1e-2),
+        tables=coll.init(jax.random.key(0)),
+        sparse_opt=sparse_optimizer("rowwise_adagrad", lr=1e-2,
+                                    weight_decay=0.0,
+                                    small_vocab_threshold=100))
+    step = make_sparse_train_step(coll, ctr_sparse_forward(bb),
+                                  mode="gspmd", donate=False, jit=False)
+    rr = np.random.default_rng(5)
+    batch = {c: jnp.asarray(rr.integers(0, sizes[c], 16), jnp.int32)
+             for c in cats}
+    batch["x0"] = jnp.asarray(rr.random(16, dtype=np.float32))
+    batch["label"] = jnp.asarray(rr.integers(0, 2, 16), jnp.float32)
+
+    norm = lambda j: re.sub(r"0x[0-9a-f]+", "0xADDR", str(j))
+    j_off = norm(jax.make_jaxpr(step)(state, batch))
+    trace.configure(tmp_path)
+    trace.emit("online", "stage", cycle=1, stage="probe")  # sink is LIVE
+    j_on = norm(jax.make_jaxpr(step)(state, batch))
+    assert j_on == j_off
+
+
+# ---------------------------------------------- rotation of sibling sinks
+
+
+def test_events_log_rotates_at_size(tmp_path):
+    from tdfo_tpu.obs import events
+
+    path = tmp_path / "events.jsonl"
+    events.configure(path, rotate_bytes=400)
+    try:
+        for i in range(40):
+            events.record("compile", name=f"fn{i}", dur_ms=float(i))
+    finally:
+        events.configure(None)
+    overflow = tmp_path / "events.jsonl.1"
+    assert overflow.exists()
+    if path.exists():
+        assert path.stat().st_size < 400 + 200
+    names = []
+    for p in (overflow, path):
+        if not p.exists():
+            continue
+        for line in p.read_text().splitlines():
+            names.append(json.loads(line)["name"])  # every line complete
+    # one generation of history: a contiguous ordered suffix survives
+    first = int(names[0][2:])
+    assert names == [f"fn{i}" for i in range(first, 40)]
+
+
+def test_heartbeat_log_rotates_at_size(tmp_path):
+    from tdfo_tpu.obs.watchdog import StallWatchdog
+
+    path = tmp_path / "heartbeat.jsonl"
+    wd = StallWatchdog(path, 10.0, rotate_bytes=300)
+    for i in range(30):
+        wd.beat(i)
+        wd.check()  # the daemon body writes the heartbeat record
+    overflow = tmp_path / "heartbeat.jsonl.1"
+    assert overflow.exists()
+    if path.exists():
+        assert path.stat().st_size < 300 + 300
+    steps = []
+    for p in (overflow, path):
+        if not p.exists():
+            continue
+        for line in p.read_text().splitlines():
+            steps.append(json.loads(line)["last_step"])
+    assert steps == sorted(steps)  # one generation retired, order preserved
+
+
+# ------------------------------------------------------ launch.py obs
+
+
+def test_launch_obs_subcommand(tmp_path, capsys):
+    from tdfo_tpu.launch import main
+
+    out_dir = tmp_path / "run"
+    trace.configure(out_dir / "trace")
+    trace.emit("frontend", "serve_request", replica=0, seq=1,
+               latency_ms=2.0, version=3, digest="d0")
+    trace.emit("replay", "replay_batch", rows=4, consumed=[[1, 0, 2]])
+    _cycle_span(1, version=3, consumed=[(1, 0, 2)])
+    trace.configure(None)
+    cfgp = tmp_path / "config.toml"
+    cfgp.write_text(f'checkpoint_dir = "{out_dir}"\n')
+    assert main(["obs", "--config", str(cfgp)]) == 0
+    out = capsys.readouterr().out
+    assert "cycle 1" in out and "verdict=promote" in out
+    chrome = json.loads((out_dir / "trace" / "chrome_trace.json").read_text())
+    assert chrome["traceEvents"]
+
+    (tmp_path / "empty.toml").write_text(
+        f'checkpoint_dir = "{tmp_path / "nothing"}"\n')
+    with pytest.raises(SystemExit, match="no trace"):
+        main(["obs", "--config", str(tmp_path / "empty.toml")])
