@@ -1085,6 +1085,96 @@ def bench_serving(batch_size: int = 8192, embed_dim: int = 64,
     return out
 
 
+def bench_serve_fleet(replicas: int = 2, embed_dim: int = 16,
+                      requests_per_step: int = 128, knee_steps: int = 3,
+                      p99_slo_ms: float = 50.0) -> dict:
+    """``serve_fleet8``: sustained QPS per replica at a fixed p99 SLO
+    through the out-of-process serving stack (socket ingress -> replica
+    processes, ``tdfo_tpu/serve/supervisor.py``).
+
+    This measures the HOST serving stack — framing, balancing, process
+    hops, micro-batching — not the chip: replica children always run
+    ``JAX_PLATFORMS=cpu`` (one TPU job at a time through the tunnel,
+    CLAUDE.md), so the record is meaningful on and off TPU and carries no
+    ``on_tpu`` gate.  A closed-loop zipf sweep doubles concurrency per
+    step; the knee is the last step whose p99 met the SLO.
+    """
+    import tempfile
+
+    import jax
+
+    from tdfo_tpu.core.config import Config, LoadgenSpec, ServingSpec
+    from tdfo_tpu.models.twotower import TwoTowerBackbone, ctr_embedding_specs
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.parallel.embedding import ShardedEmbeddingCollection
+    from tdfo_tpu.serve.export import export_bundle
+    from tdfo_tpu.serve.loadgen import LoadGenerator
+    from tdfo_tpu.serve.supervisor import ProcessFleet
+    from tdfo_tpu.serve.swap import BundleStore
+    from tdfo_tpu.train.sparse_step import SparseTrainState
+
+    import jax.numpy as jnp
+    import optax
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    coll = ShardedEmbeddingCollection(
+        ctr_embedding_specs(SIZE_MAP, embed_dim, "row"), mesh=mesh)
+    backbone = TwoTowerBackbone(embed_dim=embed_dim)
+    dummy_e = {f: jnp.zeros((1, embed_dim), jnp.float32)
+               for f in coll.features()}
+    dummy_c = {"avg_rating": jnp.zeros((1,)), "num_pages": jnp.zeros((1,))}
+    state = SparseTrainState.create(
+        dense_params=backbone.init(jax.random.key(1), dummy_e,
+                                   dummy_c)["params"],
+        tx=optax.adamw(3e-4), tables=coll.init(jax.random.key(0)),
+        sparse_opt=sparse_optimizer("adam", lr=3e-4),
+    )
+    vocab = {"user_id": SIZE_MAP["user"], "item_id": SIZE_MAP["item"],
+             "language": SIZE_MAP["language"], "is_ebook": 2,
+             "format": SIZE_MAP["format"],
+             "publisher": SIZE_MAP["publisher"],
+             "pub_decade": SIZE_MAP["pub_decade"]}
+    with tempfile.TemporaryDirectory() as td:
+        bundle_dir = export_bundle(
+            td + "/bundle", model="twotower", embed_dim=embed_dim,
+            cat_columns=tuple(vocab), cont_columns=("avg_rating",
+                                                    "num_pages"),
+            size_map=SIZE_MAP, coll=coll, tables=state.tables,
+            dense_params=state.dense_params)
+        store = BundleStore(td + "/store")
+        if store.recover() is None:
+            store.ingest_full(bundle_dir)
+        cfg = Config().replace(
+            serving=ServingSpec(replicas=replicas, fleet_mode="process"),
+            loadgen=LoadgenSpec(mode="closed", requests=requests_per_step,
+                                rows_per_request=16, p99_slo_ms=p99_slo_ms))
+        fleet = ProcessFleet(store, cfg, workdir=td)
+        try:
+            fleet.sync()
+            gen = LoadGenerator(fleet.ingress, cfg.loadgen, vocab,
+                                ("avg_rating", "num_pages"))
+            report = gen.knee(steps=knee_steps)
+        finally:
+            fleet.close()
+    knee = report["knee"]
+    out = {
+        "replicas": replicas,
+        "p99_slo_ms": p99_slo_ms,
+        "steps": [{k: s[k] for k in ("concurrency", "achieved_qps",
+                                     "p50_ms", "p99_ms", "shed", "failed",
+                                     "slo_ok")}
+                  for s in report["steps"]],
+    }
+    if knee is not None:
+        out["knee_qps"] = round(knee["achieved_qps"], 1)
+        out["qps_per_replica"] = round(knee["achieved_qps"] / replicas, 1)
+        out["knee_p99_ms"] = knee["p99_ms"]
+    return out
+
+
 def bench_retrieval_scale(n_items_list=(1_000_000, 10_000_000),
                           dim: int = 64, batch: int = 256,
                           top_k: int = 100) -> dict:
@@ -1228,6 +1318,10 @@ def main() -> None:
                          "sidecar — both keep compute f32 and write with "
                          "stochastic rounding)")
     ap.add_argument("--skip-big-table", action="store_true")
+    ap.add_argument("--skip-serve-fleet", action="store_true",
+                    help="skip the out-of-process fleet record "
+                    "(serve_fleet8: ingress + replica processes on host "
+                    "CPU — spawns subprocesses)")
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving-path records (serve_score8 / "
                          "serve_retrieve8)")
@@ -1344,6 +1438,15 @@ def main() -> None:
         except Exception as e:  # serving records must never kill the headline
             print(f"bench: serving bench failed: {e!r}", file=sys.stderr)
 
+    serve_fleet = {}
+    # no on_tpu gate: the fleet record measures the HOST serving stack
+    # (replica children are always JAX_PLATFORMS=cpu)
+    if not args.skip_serve_fleet and not args.dense:
+        try:
+            serve_fleet = bench_serve_fleet()
+        except Exception as e:  # fleet record must never kill the headline
+            print(f"bench: serve-fleet bench failed: {e!r}", file=sys.stderr)
+
     cache_zipf = {}
     if on_tpu and not args.skip_cache and not args.dense:
         try:
@@ -1417,6 +1520,7 @@ def main() -> None:
         "embedding_lookup_p50_us": lookup,
         "big_table_demo": big_table,
         "serving": serving,
+        "serve_fleet8": serve_fleet,
         "cache_zipf": cache_zipf,
         "retrieve_twostage8": retrieval_scale,
         "planner_dlrm8": planner_rec,

@@ -237,6 +237,83 @@ class ServingSpec:
     # (serve/swap.py gc_versions, wired through recover() and promotion).
     # 0 = keep everything (the pre-retention behaviour).
     keep_versions: int = 0
+    # fleet execution boundary: "inproc" keeps replicas as Python objects
+    # inside the supervisor process (the PR-14 layout — spoofed-mesh unit
+    # tests, zero process overhead); "process" runs each ReplicaFrontend as
+    # a real OS process behind the socket ingress (serve/supervisor.py +
+    # serve/ingress.py + serve/wire.py) so death drills are real SIGKILLs
+    # and respawns cross a true process boundary.  Requires replicas >= 2.
+    fleet_mode: str = "inproc"
+    # heartbeat-staleness eviction window in milliseconds: the balancer
+    # treats a replica whose last heartbeat is older than this as dead and
+    # stops routing requests to it (serve/ingress.py; a stalled replica
+    # keeping its final queue_depth forever was the PR-14 gap).  Must be
+    # > 0 — a fleet cannot run without an eviction bound.
+    heartbeat_stale_ms: float = 5000.0
+    # wire-protocol frame cap in bytes (serve/wire.py): a declared frame
+    # length beyond this is refused BEFORE the body is read, on both sides
+    # — the bound on memory a malformed or hostile peer can demand.
+    max_frame_bytes: int = 8 << 20
+    # ingress -> replica connect retries (serve/wire.py connect, routed
+    # through utils/retry.backoff_delay — the single backoff law); the
+    # respawn window is exactly when these fire.  The default schedule
+    # (10 attempts from 10 ms, capped at 2 s, ~4.5 s of cumulative sleep)
+    # rides out a fresh child's interpreter + jax import; the child binds
+    # its listener before loading the bundle, so the first RPC blocks on
+    # the slow part instead of the connect.
+    connect_retries: int = 10
+    # base delay in milliseconds for the connect-retry backoff schedule
+    # (doubles per attempt, capped + jittered by utils/retry.backoff_delay).
+    connect_base_ms: float = 10.0
+    # supervisor respawn backoff base in milliseconds: a replica's K-th
+    # consecutive death waits backoff_delay(K) scaled from this base before
+    # the respawn (serve/supervisor.py), so a crash-looping child cannot
+    # hot-spin the supervisor.
+    respawn_base_ms: float = 50.0
+    # cap on the respawn backoff delay in milliseconds.
+    respawn_max_ms: float = 2000.0
+    # flap-quarantine window in seconds: deaths older than this no longer
+    # count against a replica.
+    flap_window_s: float = 30.0
+    # deaths within flap_window_s that quarantine a replica permanently
+    # (no further respawns; the fleet degrades to the survivors and the
+    # quarantine is recorded loudly, never silent).
+    flap_max_deaths: int = 3
+
+
+@dataclass(frozen=True)
+class LoadgenSpec:
+    """``[loadgen]`` config table: the closed/open-loop load-generation
+    harness (``serve/loadgen.py`` + ``launch.py loadgen``) that drives a
+    process fleet to saturation and records the latency/throughput knee
+    through the trace assembler's cohort p50/p99 histograms.
+
+    Every key is observable (``tests/test_config.py``).
+    """
+
+    # arrival discipline: "closed" keeps exactly `concurrency` requests in
+    # flight (each completion immediately issues the next — the classic
+    # closed-loop saturation probe); "open" issues at `rate_qps` regardless
+    # of completions (the knee appears as queueing + sheds, not slowdown).
+    mode: str = "closed"
+    # total requests to issue per run.
+    requests: int = 200
+    # closed-loop concurrency: in-flight request cap (ignored for "open").
+    concurrency: int = 8
+    # open-loop arrival rate in requests/second (ignored for "closed").
+    rate_qps: float = 100.0
+    # zipf exponent for item-popularity skew in generated request batches
+    # (> 1; larger = hotter head — the realistic serving distribution).
+    zipf_a: float = 1.1
+    # rows per generated request batch (micro-batcher fill pressure).
+    rows_per_request: int = 4
+    # rng seed for the request stream (ids, continuous features, arrival
+    # jitter) — a fixed seed makes knee runs comparable across builds.
+    seed: int = 606
+    # the SLO the knee is measured against: bench.py serve_fleet reports
+    # sustained QPS/replica at this p99 bound, and past the knee admitted
+    # requests must still meet it while sheds are counted, never silent.
+    p99_slo_ms: float = 50.0
 
 
 @dataclass(frozen=True)
@@ -529,6 +606,8 @@ class Config:
     train: TrainSpec = field(default_factory=TrainSpec)
     # [serving] table: online-inference knobs (launch serve / tdfo_tpu.serve)
     serving: ServingSpec = field(default_factory=ServingSpec)
+    # [loadgen] table: load-generation harness knobs (launch loadgen)
+    loadgen: LoadgenSpec = field(default_factory=LoadgenSpec)
     # [telemetry] table: flight-recorder knobs (tdfo_tpu/obs)
     telemetry: TelemetrySpec = field(default_factory=TelemetrySpec)
     # [online] table: serve -> retrain -> swap supervisor knobs
@@ -826,6 +905,59 @@ class Config:
             raise ValueError(
                 "serving keep_versions must be >= 0 (0 = keep every "
                 "published version)")
+        if self.serving.fleet_mode not in ("inproc", "process"):
+            raise ValueError(
+                "serving fleet_mode must be 'inproc' or 'process', got "
+                f"{self.serving.fleet_mode!r}")
+        if self.serving.fleet_mode == "process" and self.serving.replicas < 2:
+            raise ValueError(
+                "serving fleet_mode = 'process' requires replicas >= 2: a "
+                "one-process fleet has no survivors to degrade to — use the "
+                "single-frontend 'inproc' layout instead")
+        if self.serving.heartbeat_stale_ms <= 0:
+            raise ValueError(
+                "serving heartbeat_stale_ms must be > 0: the balancer needs "
+                "a finite staleness bound to evict silent replicas")
+        if self.serving.max_frame_bytes < 1024:
+            raise ValueError(
+                "serving max_frame_bytes must be >= 1024 (the wire refuses "
+                "frames beyond it; smaller caps cannot carry a sync message)")
+        if self.serving.connect_retries < 1:
+            raise ValueError("serving connect_retries must be >= 1")
+        if self.serving.connect_base_ms <= 0:
+            raise ValueError("serving connect_base_ms must be > 0")
+        if self.serving.respawn_base_ms <= 0:
+            raise ValueError("serving respawn_base_ms must be > 0")
+        if self.serving.respawn_max_ms < self.serving.respawn_base_ms:
+            raise ValueError(
+                "serving respawn_max_ms must be >= respawn_base_ms (it caps "
+                "the respawn backoff schedule)")
+        if self.serving.flap_window_s <= 0:
+            raise ValueError("serving flap_window_s must be > 0")
+        if self.serving.flap_max_deaths < 2:
+            raise ValueError(
+                "serving flap_max_deaths must be >= 2: one death must never "
+                "quarantine a replica (every kill drill dies exactly once)")
+        if self.loadgen.mode not in ("closed", "open"):
+            raise ValueError(
+                "loadgen mode must be 'closed' or 'open', got "
+                f"{self.loadgen.mode!r}")
+        if self.loadgen.requests < 1:
+            raise ValueError("loadgen requests must be >= 1")
+        if self.loadgen.concurrency < 1:
+            raise ValueError("loadgen concurrency must be >= 1")
+        if self.loadgen.rate_qps <= 0:
+            raise ValueError("loadgen rate_qps must be > 0")
+        if self.loadgen.zipf_a <= 1.0:
+            raise ValueError(
+                "loadgen zipf_a must be > 1 (the zipf popularity exponent; "
+                "<= 1 has no normalizable tail)")
+        if self.loadgen.rows_per_request < 1:
+            raise ValueError("loadgen rows_per_request must be >= 1")
+        if self.loadgen.p99_slo_ms <= 0:
+            raise ValueError(
+                "loadgen p99_slo_ms must be > 0 (the SLO the knee is "
+                "measured against)")
         if self.telemetry.stall_timeout_s < 0:
             raise ValueError(
                 "telemetry stall_timeout_s must be >= 0 (0 = watchdog off)")
@@ -972,6 +1104,7 @@ _FAULT_FIELDS = {f.name for f in dataclasses.fields(FaultSpec)}
 _EMBEDDINGS_FIELDS = {f.name for f in dataclasses.fields(EmbeddingsSpec)}
 _TRAIN_FIELDS = {f.name for f in dataclasses.fields(TrainSpec)}
 _SERVING_FIELDS = {f.name for f in dataclasses.fields(ServingSpec)}
+_LOADGEN_FIELDS = {f.name for f in dataclasses.fields(LoadgenSpec)}
 _TELEMETRY_FIELDS = {f.name for f in dataclasses.fields(TelemetrySpec)}
 _ONLINE_FIELDS = {f.name for f in dataclasses.fields(OnlineSpec)}
 _PLANNER_FIELDS = {f.name for f in dataclasses.fields(PlannerSpec)}
@@ -1044,6 +1177,16 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
                                buckets=tuple(serving_raw["buckets"]))
         serving = ServingSpec(**serving_raw)
 
+    loadgen_raw = raw.pop("loadgen", {})
+    if isinstance(loadgen_raw, LoadgenSpec):
+        loadgen = loadgen_raw
+    else:
+        unknown_loadgen = set(loadgen_raw) - _LOADGEN_FIELDS
+        if unknown_loadgen:
+            raise ValueError(
+                f"unknown loadgen config keys: {sorted(unknown_loadgen)}")
+        loadgen = LoadgenSpec(**loadgen_raw)
+
     telemetry_raw = raw.pop("telemetry", {})
     if isinstance(telemetry_raw, TelemetrySpec):
         telemetry = telemetry_raw
@@ -1085,8 +1228,8 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
             raw[key] = tuple(raw[key])  # toml arrays / lists -> tuples
 
     cfg = Config(mesh=mesh, faults=faults, embeddings=embeddings, train=train,
-                 serving=serving, telemetry=telemetry, online=online,
-                 planner=planner, **raw)
+                 serving=serving, loadgen=loadgen, telemetry=telemetry,
+                 online=online, planner=planner, **raw)
     if not cfg.size_map:
         size_map = load_size_map(cfg.data_dir)
         if size_map:

@@ -19,6 +19,17 @@ Subcommands:
     with ``[online] canary_cycles > 0`` every candidate is shadow-scored on
     held-out replayed traffic, canaried on a fraction of the serving fleet
     and auto-rolled-back on AUC regression; knobs live in ``[online]``.
+    ``[serving] fleet_mode = "process"`` runs the fleet as real OS
+    processes behind a socket ingress with a respawning supervisor
+    (``tdfo_tpu/serve/supervisor.py``).
+  * ``serve-fleet``          — export a bundle and stand up the
+    out-of-process fleet (N ``serve/replica_main.py`` children behind the
+    power-of-two-choices ingress), then push a synthetic trace through it;
+    the process twin of ``serve`` with ``[serving] replicas > 1``.
+  * ``loadgen``              — drive the out-of-process fleet with zipf
+    traffic (``[loadgen]``: open/closed loop, concurrency, rate) sweeping
+    the load axis to the latency/throughput knee
+    (``tdfo_tpu/serve/loadgen.py``).
   * ``plan``                 — price every per-table embedding placement
     against the measured cost model (``tdfo_tpu/plan``) using the
     preprocessing ``table_stats.json`` and write ``sharding_plan.json``;
@@ -57,7 +68,8 @@ def _init_distributed(flag: str) -> None:
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tdfo_tpu.launch", description=__doc__)
     p.add_argument("command", nargs="?", default="train",
-                   choices=["train", "serve", "online", "plan", "obs",
+                   choices=["train", "serve", "serve-fleet", "loadgen",
+                            "online", "plan", "obs",
                             "preprocess-ctr", "preprocess-seq",
                             "preprocess-criteo", "synth", "synth-criteo"])
     p.add_argument("--config", default="config.toml", help="path to config.toml")
@@ -204,6 +216,30 @@ def main(argv: list[str] | None = None) -> int:
         stats = serve_from_config(cfg, log_dir=args.log_dir)
         print({k: round(v, 5) if isinstance(v, float) else v
                for k, v in stats.items()})
+        return 0
+    if args.command == "serve-fleet":
+        from tdfo_tpu.serve.loadgen import serve_fleet_from_config
+
+        stats = serve_fleet_from_config(cfg, log_dir=args.log_dir)
+        print({k: round(v, 5) if isinstance(v, float) else v
+               for k, v in stats.items()})
+        return 0
+    if args.command == "loadgen":
+        from tdfo_tpu.serve.loadgen import loadgen_from_config
+
+        report = loadgen_from_config(cfg, log_dir=args.log_dir)
+        for s in report["steps"]:
+            axis = (f"conc={s['concurrency']}" if s["mode"] == "closed"
+                    else f"rate={s['offered_qps']:.1f}qps")
+            p99 = "-" if s["p99_ms"] is None else f"{s['p99_ms']:.2f}ms"
+            print(f"loadgen {s['mode']} {axis}: "
+                  f"qps={s['achieved_qps']:.1f} p99={p99} "
+                  f"shed={s['shed']} failed={s['failed']} "
+                  f"slo_ok={s['slo_ok']}")
+        knee = report["knee"]
+        print("knee: none (no step met the p99 SLO)" if knee is None else
+              f"knee: qps={knee['achieved_qps']:.1f} at p99="
+              f"{knee['p99_ms']:.2f}ms (SLO {knee['p99_slo_ms']} ms)")
         return 0
     if args.command == "online":
         from tdfo_tpu.train.online import online_from_config

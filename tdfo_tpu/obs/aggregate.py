@@ -94,6 +94,8 @@ def assemble(spans: list[dict]) -> dict:
     syncs: list[dict] = []
     heartbeats: list[dict] = []
     replays: list[dict] = []
+    ingress_reqs: list[dict] = []
+    loadgen_steps: list[dict] = []
     for s in spans:
         kind = s.get("kind")
         if kind == "online_cycle":
@@ -112,6 +114,12 @@ def assemble(spans: list[dict]) -> dict:
             heartbeats.append(s)
         elif kind == "replay_batch":
             replays.append(s)
+        elif kind == "ingress_request":
+            # process-fleet path: latencies measured at the socket ingress
+            # (submit stamp -> reply receipt), shed replies carry no latency
+            ingress_reqs.append(s)
+        elif kind == "loadgen_step":
+            loadgen_steps.append(s)
 
     cycles = []
     seen_keys: dict[tuple[int, int], int] = {}
@@ -175,6 +183,17 @@ def assemble(spans: list[dict]) -> dict:
             for rid, ss in sorted(per_replica.items())
         },
     }
+    if ingress_reqs:
+        fleet["ingress"] = {
+            **_lat([s["latency_ms"] for s in ingress_reqs
+                    if not s.get("shed")]),
+            "shed": sum(1 for s in ingress_reqs if s.get("shed")),
+        }
+    out_loadgen = [{k: s.get(k) for k in
+                    ("mode", "offered", "concurrency", "offered_qps",
+                     "completed", "achieved_qps", "p50_ms", "p99_ms",
+                     "shed", "failed", "slo_ok")}
+                   for s in loadgen_steps]
     return {
         "cycles": cycles,
         "fleet": fleet,
@@ -184,6 +203,7 @@ def assemble(spans: list[dict]) -> dict:
         "n_spans": len(spans),
         "n_requests": len(requests),
         "n_replay_batches": len(replays),
+        "loadgen": out_loadgen,
     }
 
 
@@ -248,4 +268,16 @@ def format_report(report: dict) -> str:
                      f"p99={d['p99_ms']:.2f}ms "
                      f"queue_depth={d['last_queue_depth']} "
                      f"batch_fill={d['last_batch_fill']}")
+    ing = fl.get("ingress")
+    if ing and ing["n"]:
+        lines.append(f"ingress: n={ing['n']} p50={ing['p50_ms']:.2f}ms "
+                     f"p99={ing['p99_ms']:.2f}ms shed={ing['shed']}")
+    for s in report.get("loadgen", ()):
+        axis = (f"conc={s['concurrency']}" if s["mode"] == "closed"
+                else f"rate={s['offered_qps']:.1f}qps")
+        p99 = "-" if s["p99_ms"] is None else f"{s['p99_ms']:.2f}ms"
+        lines.append(
+            f"loadgen {s['mode']} {axis}: qps={s['achieved_qps']:.1f} "
+            f"p99={p99} shed={s['shed']} failed={s['failed']} "
+            f"slo_ok={s['slo_ok']}")
     return "\n".join(lines)

@@ -300,6 +300,15 @@ class ServingFleet:
                 "replica": r.replica_id, "version": r.version(),
                 "auc": binary_auc(labels, scores), "ms": ms,
                 "canary": r.canary_member,
+                # trace-clock stamp for staleness eviction: a dead replica
+                # keeps its last queue_depth/batch_fill forever, so the
+                # balancer must age records out — serve/ingress.py treats
+                # anything older than [serving] heartbeat_stale_ms as dead
+                # (freshness = _trace.elapsed_ms(hb_at), never a raw clock
+                # difference).  In-process fleets stamp at the sample;
+                # PROCESS fleets re-stamp at ingress receipt, because
+                # monotonic clocks are not comparable across processes.
+                "hb_at": _trace.clock(),
             }
             if r.batcher is not None:
                 rec["queue_depth"] = r.batcher.last_queue_depth

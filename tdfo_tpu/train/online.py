@@ -212,7 +212,19 @@ class OnlineLoop:
         else:
             self._catch_up()
         self.fleet = None
-        if config.serving.replicas > 1:
+        if config.serving.fleet_mode == "process":
+            # out-of-process fleet: each replica is a real OS process behind
+            # the socket ingress; same duck-typed surface as ServingFleet,
+            # but mark_canary_watch can deliver a REAL SIGKILL and sync()
+            # respawns/reconnects the victims (serve/supervisor.py)
+            from tdfo_tpu.serve.supervisor import ProcessFleet
+
+            self.fleet = ProcessFleet(self.store, config,
+                                      workdir=self.workdir,
+                                      logger=self.trainer.logger)
+            self.fleet.sync()
+            self.batcher = None
+        elif config.serving.replicas > 1:
             from tdfo_tpu.serve.fleet import ServingFleet
 
             self.fleet = ServingFleet(self.store, config, mesh=mesh,
@@ -692,8 +704,19 @@ class OnlineLoop:
             return self.fleet.run(requests)
         return self.batcher.run(requests)
 
+    def close(self) -> None:
+        """Release the serving side.  Required for process fleets (child
+        processes + sockets); a no-op-ish courtesy for the in-process
+        kinds."""
+        if self.fleet is not None:
+            self.fleet.close()
+
 
 def online_from_config(config, *, log_dir: str | Path | None = None
                        ) -> dict[str, Any]:
     """The ``python -m tdfo_tpu.launch online`` body."""
-    return OnlineLoop(config, log_dir=log_dir).run()
+    loop = OnlineLoop(config, log_dir=log_dir)
+    try:
+        return loop.run()
+    finally:
+        loop.close()

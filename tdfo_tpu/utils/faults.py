@@ -86,6 +86,18 @@ and ``tdfo_tpu/serve/fleet.py``) drive the fleet rollout state machine:
     (the replica stops syncing/serving; NO ``os._exit`` — the supervisor
     process survives), re-fired deterministically on every restart so
     killed and uninterrupted lineages see the same fleet membership.
+  * ``kill_replica_signal = K``  — replica K-1 (1-based K) gets a REAL
+    ``SIGKILL`` delivered to its child pid at the first canary watch round
+    (process fleets only, ``[serving] fleet_mode = "process"``): the
+    supervisor must detect the death, respawn the lineage with backoff,
+    and the respawn must re-follow CURRENT/CANARY by (version, digest)
+    with a seq-contiguous request log.  The in-process flag twin is
+    ``kill_replica_nth`` — spoofed-mesh unit tests use the flag (cheap,
+    membership stays degraded), OS-boundary drills use the signal
+    (``tests/test_fleet_process.py``); the soft-kill path is exercised by
+    ``tests/test_fleet.py``.  Fires once per process, no marker — the
+    respawn recovers membership, so a restarted supervisor re-firing the
+    kill converges to the same fleet state.
   * ``slow_canary_at_cycle = N`` (+ ``slow_score_ms = M``)  — the candidate
     of gated cycle N scores slowly ON THE REPLICAS THAT LOAD IT (the fleet
     wraps that digest's scorer in an M-ms host sleep): a latency
@@ -141,6 +153,7 @@ class FaultSpec:
     regress_auc_at_cycle: int = 0
     kill_during_canary: int = 0
     kill_replica_nth: int = 0
+    kill_replica_signal: int = 0
     slow_canary_at_cycle: int = 0
 
     def __post_init__(self) -> None:
@@ -151,7 +164,8 @@ class FaultSpec:
                      "corrupt_record_nth", "kill_during_replay",
                      "kill_between_stages", "corrupt_candidate",
                      "regress_auc_at_cycle", "kill_during_canary",
-                     "kill_replica_nth", "slow_canary_at_cycle"):
+                     "kill_replica_nth", "kill_replica_signal",
+                     "slow_canary_at_cycle"):
             if getattr(self, name) < 0:
                 raise ValueError(f"faults.{name} must be >= 0 (0 = disabled)")
 
@@ -164,6 +178,7 @@ class FaultSpec:
                     or self.kill_during_replay or self.kill_between_stages
                     or self.corrupt_candidate or self.regress_auc_at_cycle
                     or self.kill_during_canary or self.kill_replica_nth
+                    or self.kill_replica_signal
                     or self.slow_canary_at_cycle)
 
 
@@ -189,6 +204,7 @@ class FaultInjector:
         self._candidate_fired = False
         self._canary_count = 0
         self._replica_kill_fired = False
+        self._replica_sigkill_fired = False
 
     # ------------------------------------------------------------- kill
 
@@ -472,6 +488,21 @@ class FaultInjector:
         self._replica_kill_fired = True
         print(f"[faults] soft-killing replica "
               f"{self.spec.kill_replica_nth - 1} at canary watch", flush=True)
+        return True
+
+    def replica_sigkill_due(self) -> bool:
+        """Called by the PROCESS fleet at the start of each canary watch
+        round.  True exactly once per process — the fleet then delivers a
+        real ``SIGKILL`` to child ``kill_replica_signal - 1``'s pid and the
+        supervisor's respawn path takes over.  No marker, like
+        :meth:`replica_kill_due`: the respawn recovers membership, so a
+        restarted supervisor re-firing the kill converges anyway."""
+        if not self.spec.kill_replica_signal or self._replica_sigkill_fired:
+            return False
+        self._replica_sigkill_fired = True
+        print(f"[faults] SIGKILLing replica process "
+              f"{self.spec.kill_replica_signal - 1} at canary watch",
+              flush=True)
         return True
 
     # --------------------------------------------------------------- io

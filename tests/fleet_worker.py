@@ -12,8 +12,11 @@ Spec keys: ``data_dir``, ``checkpoint_dir``, ``log_dir``, ``request_log``
 ``keep_consumed_segments``, ``telemetry`` (a ``[telemetry]`` dict — trace
 / log_rotate_bytes), ``faults`` (a ``[faults]`` dict —
 regress_auc_at_cycle / kill_during_canary / kill_replica_nth /
-corrupt_candidate / kill_between_stages / kill_during_swap /
-slow_canary_at_cycle + slow_score_ms), ``probe_seed``.
+kill_replica_signal / corrupt_candidate / kill_between_stages /
+kill_during_swap / slow_canary_at_cycle + slow_score_ms),
+``fleet_mode`` ("inproc" default; "process" runs the fleet as real OS
+processes behind the socket ingress — tests/test_fleet_process.py),
+``probe_seed``.
 
 Spoofs CPU devices and runs the REAL gated ``OnlineLoop``
 (``train/online.py`` with ``[online] canary_cycles > 0``) over a
@@ -43,13 +46,8 @@ def main() -> None:
 
     jax.config.update("jax_default_matmul_precision", "highest")
 
-    import numpy as np
-
     from tdfo_tpu.core.config import load_size_map, read_configs
-    from tdfo_tpu.serve.export import read_raw_bundle
-    from tdfo_tpu.serve.frontend import _column_vocab
     from tdfo_tpu.train.online import OnlineLoop
-    from tdfo_tpu.train.trainer import _ctr_columns
 
     cfg = read_configs(
         None,
@@ -70,6 +68,11 @@ def main() -> None:
         serving=dict(
             replicas=int(spec.get("replicas", 2)),
             keep_versions=int(spec.get("keep_versions", 0)),
+            # "process" runs the fleet as real OS processes behind the
+            # socket ingress (serve/supervisor.py); kill drills then use
+            # [faults] kill_replica_signal (a real SIGKILL) instead of the
+            # in-process kill_replica_nth flag
+            fleet_mode=str(spec.get("fleet_mode", "inproc")),
         ),
         online=dict(
             request_log=spec["request_log"],
@@ -86,6 +89,19 @@ def main() -> None:
         ),
     )
     loop = OnlineLoop(cfg, log_dir=spec["log_dir"])
+    try:
+        _probe_and_report(loop, cfg, spec)
+    finally:
+        loop.close()  # even on a crash: never leak replica children
+
+
+def _probe_and_report(loop, cfg, spec: dict) -> None:
+    import numpy as np
+
+    from tdfo_tpu.serve.export import read_raw_bundle
+    from tdfo_tpu.serve.frontend import _column_vocab
+    from tdfo_tpu.train.trainer import _ctr_columns
+
     stats = loop.run()
 
     # deterministic probe trace through EVERY alive replica's live batcher:
@@ -103,6 +119,12 @@ def main() -> None:
         requests.append((f"probe{i}", batch))
     per_replica = loop.fleet.probe_each(requests)
 
+    # process fleets: how often the supervisor respawned each replica (the
+    # SIGKILL drill asserts the victim's lineage actually died and came back)
+    respawns = {str(k): v
+                for k, v in getattr(getattr(loop.fleet, "supervisor", None),
+                                    "respawns", {}).items()}
+
     manifest, _ = read_raw_bundle(loop.store.current_dir())
     Path(spec["out_json"]).write_text(json.dumps({
         "stats": stats,
@@ -115,6 +137,7 @@ def main() -> None:
         "replica_versions": {str(k): v
                              for k, v in loop.fleet.versions().items()},
         "dead_replicas": sorted(loop.fleet._dead),
+        "respawns": respawns,
         "logits": {str(rid): {q: np.asarray(v).tolist()
                               for q, v in res.items()}
                    for rid, res in per_replica.items()},

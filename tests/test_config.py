@@ -699,3 +699,140 @@ def test_trace_and_latency_gate_knobs(tmp_path: Path):
 
     with pytest.raises(ValueError, match="max_p99_regression_ms"):
         Config(online=OnlineSpec(max_p99_regression_ms=-1.0))
+
+
+def test_process_fleet_knobs(tmp_path: Path):
+    """PR-16 [serving] knobs for the out-of-process fleet: fleet_mode,
+    the ingress eviction window / frame cap / connect schedule, and the
+    supervisor respawn-backoff + flap-quarantine parameters — defaults,
+    toml round-trip, and every rejection."""
+    from tdfo_tpu.core.config import ServingSpec
+
+    cfg = read_configs()
+    assert cfg.serving.fleet_mode == "inproc"  # in-process fleet: PR-14
+    assert cfg.serving.heartbeat_stale_ms == 5000.0
+    assert cfg.serving.max_frame_bytes == 8 << 20
+    assert cfg.serving.connect_retries == 10
+    assert cfg.serving.connect_base_ms == 10.0
+    assert cfg.serving.respawn_base_ms == 50.0
+    assert cfg.serving.respawn_max_ms == 2000.0
+    assert cfg.serving.flap_window_s == 30.0
+    assert cfg.serving.flap_max_deaths == 3
+
+    (tmp_path / "config.toml").write_text(
+        "[serving]\nreplicas = 3\nfleet_mode = \"process\"\n"
+        "heartbeat_stale_ms = 750.0\nmax_frame_bytes = 65536\n"
+        "connect_retries = 4\nconnect_base_ms = 5.0\n"
+        "respawn_base_ms = 25.0\nrespawn_max_ms = 400.0\n"
+        "flap_window_s = 10.0\nflap_max_deaths = 2\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.serving.fleet_mode == "process"
+    assert cfg.serving.heartbeat_stale_ms == 750.0
+    assert cfg.serving.max_frame_bytes == 65536
+    assert cfg.serving.connect_retries == 4
+    assert cfg.serving.connect_base_ms == 5.0
+    assert cfg.serving.respawn_base_ms == 25.0
+    assert cfg.serving.respawn_max_ms == 400.0
+    assert cfg.serving.flap_window_s == 10.0
+    assert cfg.serving.flap_max_deaths == 2
+
+    for kw, match in (
+        (dict(fleet_mode="threads"), "fleet_mode"),
+        (dict(heartbeat_stale_ms=0.0), "heartbeat_stale_ms"),
+        (dict(max_frame_bytes=512), "max_frame_bytes"),
+        (dict(connect_retries=0), "connect_retries"),
+        (dict(connect_base_ms=0.0), "connect_base_ms"),
+        (dict(respawn_base_ms=0.0), "respawn_base_ms"),
+        (dict(respawn_base_ms=100.0, respawn_max_ms=50.0),
+         "respawn_max_ms"),
+        (dict(flap_window_s=0.0), "flap_window_s"),
+        (dict(flap_max_deaths=1), "flap_max_deaths"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(serving=ServingSpec(**kw))
+    # a process fleet needs at least two replicas: one process cannot host
+    # a canary cohort AND a stable cohort
+    with pytest.raises(ValueError, match="replicas >= 2"):
+        Config(serving=ServingSpec(replicas=1, fleet_mode="process"))
+    Config(serving=ServingSpec(replicas=2, fleet_mode="process"))
+
+
+def test_loadgen_table(tmp_path: Path):
+    """The [loadgen] table: defaults, toml round-trip, unknown-key
+    rejection, and every validation — plus the observable semantics of
+    mode/seed (the generated stream is a pure function of the spec)."""
+    from tdfo_tpu.core.config import LoadgenSpec
+
+    cfg = read_configs()
+    assert cfg.loadgen.mode == "closed"
+    assert cfg.loadgen.requests == 200
+    assert cfg.loadgen.concurrency == 8
+    assert cfg.loadgen.rate_qps == 100.0
+    assert cfg.loadgen.zipf_a == 1.1
+    assert cfg.loadgen.rows_per_request == 4
+    assert cfg.loadgen.seed == 606
+    assert cfg.loadgen.p99_slo_ms == 50.0
+
+    (tmp_path / "config.toml").write_text(
+        "[loadgen]\nmode = \"open\"\nrequests = 32\nconcurrency = 2\n"
+        "rate_qps = 250.0\nzipf_a = 1.5\nrows_per_request = 8\n"
+        "seed = 7\np99_slo_ms = 20.0\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.loadgen.mode == "open"
+    assert cfg.loadgen.requests == 32
+    assert cfg.loadgen.concurrency == 2
+    assert cfg.loadgen.rate_qps == 250.0
+    assert cfg.loadgen.zipf_a == 1.5
+    assert cfg.loadgen.rows_per_request == 8
+    assert cfg.loadgen.seed == 7
+    assert cfg.loadgen.p99_slo_ms == 20.0
+
+    (tmp_path / "config.toml").write_text("[loadgen]\nbogus = 1\n")
+    with pytest.raises(ValueError, match="loadgen"):
+        read_configs(tmp_path / "config.toml")
+
+    for kw, match in (
+        (dict(mode="poisson"), "mode"),
+        (dict(requests=0), "requests"),
+        (dict(concurrency=0), "concurrency"),
+        (dict(rate_qps=0.0), "rate_qps"),
+        (dict(zipf_a=1.0), "zipf_a"),
+        (dict(rows_per_request=0), "rows_per_request"),
+        (dict(p99_slo_ms=0.0), "p99_slo_ms"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(loadgen=LoadgenSpec(**kw))
+
+    # seed/rows_per_request are observable: the synthetic stream is a pure
+    # function of the spec (same seed -> same ids; different seed differs)
+    from tdfo_tpu.serve.loadgen import LoadGenerator
+
+    def stream(seed):
+        gen = LoadGenerator(None, LoadgenSpec(seed=seed, rows_per_request=6),
+                            {"user_id": 100})
+        return [gen.request()[1]["user_id"].tolist() for _ in range(3)]
+
+    assert stream(3) == stream(3)
+    assert stream(3) != stream(4)
+    assert all(len(b) == 6 for b in stream(5))
+
+
+def test_sigkill_fault_trigger(tmp_path: Path):
+    """[faults] kill_replica_signal round-trips, arms the injector
+    exactly once per process, and rejects negatives — the real-SIGKILL
+    twin of kill_replica_nth (tests/test_fleet_process.py uses the
+    signal, tests/test_fleet.py the in-process flag)."""
+    from tdfo_tpu.utils.faults import FaultInjector, FaultSpec
+
+    (tmp_path / "config.toml").write_text(
+        "[faults]\nkill_replica_signal = 2\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.faults.kill_replica_signal == 2
+    assert cfg.faults.any()
+
+    inj = FaultInjector(cfg.faults)
+    assert inj.replica_sigkill_due()  # fires once...
+    assert not inj.replica_sigkill_due()  # ...and only once per process
+    assert not FaultInjector(FaultSpec()).replica_sigkill_due()
+    with pytest.raises(ValueError, match="kill_replica_signal"):
+        FaultSpec(kill_replica_signal=-1)

@@ -782,3 +782,62 @@ def test_no_hard_exits_outside_fault_injector():
         "os._exit outside utils/faults.py (skips atexit/finally/buffers — "
         "raise, or route deterministic kills through the fault injector): "
         + ", ".join(offenders))
+
+
+def test_sockets_and_process_spawning_confined_to_serve_plumbing():
+    """``serve/wire.py`` owns the socket monopoly (length-prefixed framing,
+    max-frame refusal, connect-retry through the single backoff law) and
+    ``serve/supervisor.py`` owns process spawning (respawn backoff, flap
+    quarantine, child reaping).  A raw ``socket.socket`` or
+    ``subprocess.Popen`` anywhere else in the package dodges framing,
+    frame-size limits, retry budgets and child supervision — exactly the
+    failure modes the kill -9 drills exist to catch.  ``subprocess.run``
+    (bounded, reaped — ``native/__init__.py``) and pure lookups like
+    ``socket.gethostname`` stay legal.  Self-tested on a synthetic
+    offender."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    BLESSED = {"serve/wire.py", "serve/supervisor.py"}
+    SOCKET_CTORS = {"socket", "create_connection", "create_server",
+                    "socketpair"}
+
+    def spawn_lines(tree):
+        hits = []
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)):
+                continue
+            mod, attr = node.func.value.id, node.func.attr
+            if (mod == "socket" and attr in SOCKET_CTORS) or (
+                    mod == "subprocess" and attr == "Popen"):
+                hits.append(node.lineno)
+        return hits
+
+    synthetic = (
+        "import socket, subprocess\n"
+        "def sneak(path):\n"
+        "    s = socket.socket(socket.AF_UNIX)\n"
+        "    host = socket.gethostname()\n"        # legal: pure lookup
+        "    subprocess.run(['true'])\n"           # legal: bounded + reaped
+        "    return subprocess.Popen(['sleep', '9'])\n")
+    assert spawn_lines(ast.parse(synthetic)) == [3, 6]
+
+    offenders, sanctioned_hits = [], 0
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        lines = spawn_lines(ast.parse(path.read_text(), filename=str(path)))
+        if rel in BLESSED:
+            sanctioned_hits += len(lines)
+            continue
+        offenders += [f"{path}:{ln}" for ln in lines]
+    assert sanctioned_hits >= 2  # wire's listener/dial + supervisor's Popen
+    assert not offenders, (
+        "raw socket/process spawning outside serve/wire.py + "
+        "serve/supervisor.py (dodges framing, frame limits, retry budgets "
+        "and child supervision — route through wire.listen/wire.connect or "
+        "ProcessSupervisor): " + ", ".join(offenders))

@@ -1,0 +1,198 @@
+"""Wire-protocol unit tests (``tdfo_tpu/serve/wire.py``): framing
+round-trips, torn/partial frames, oversized-payload refusal, the f32
+JSON codec, and the connect-retry backoff schedule under an injected rng
+— every failure mode a kill -9 mid-write can produce, without spawning a
+process.
+
+Raw ``socket`` use is legal here: the test_quality.py monopoly rule scans
+``tdfo_tpu/`` only, and these tests ARE the monopoly's contract checks.
+"""
+
+import random
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from tdfo_tpu.serve import wire
+from tdfo_tpu.utils.retry import backoff_delay
+
+
+@pytest.fixture()
+def pair():
+    a, b = socket.socketpair()
+    yield a, b
+    a.close()
+    b.close()
+
+
+def test_roundtrip_and_frame_boundaries(pair):
+    """Messages round-trip exactly, back-to-back frames stay separated,
+    and a clean close at a frame boundary raises Disconnect (the shape a
+    graceful peer shutdown produces)."""
+    a, b = pair
+    msgs = [{"type": "score", "rid": 7, "feats": {}},
+            {"type": "drain"},
+            {"type": "reply", "rid": 7, "scores": [0.25, -1.5]}]
+    for m in msgs:
+        wire.send_msg(a, m)
+    assert [wire.recv_msg(b) for _ in msgs] == msgs
+    a.close()
+    with pytest.raises(wire.Disconnect):
+        wire.recv_msg(b)
+
+
+def test_torn_header_and_torn_body_are_loud(pair):
+    """EOF mid-header or mid-body is a torn frame — a WireError naming the
+    tear, never a silent Disconnect: the bytes already read would
+    otherwise desync every later frame on a reused connection."""
+    a, b = pair
+    a.sendall(b"\x00\x00")  # 2 of 4 header bytes
+    a.close()
+    with pytest.raises(wire.WireError, match="torn frame"):
+        wire.recv_msg(b)
+
+    c, d = socket.socketpair()
+    try:
+        c.sendall(wire._HEADER.pack(100) + b'{"type":')  # 8 of 100 body bytes
+        c.close()
+        with pytest.raises(wire.WireError, match="torn frame"):
+            wire.recv_msg(d)
+    finally:
+        d.close()
+
+
+def test_oversized_frame_refused_from_declared_length(pair):
+    """The receiver refuses an oversized frame from the DECLARED length —
+    before buffering a single body byte — and the sender refuses to send
+    one at all.  max_frame is the memory-safety valve: without the header
+    check a hostile or corrupt peer makes the ingress allocate the whole
+    declared length."""
+    a, b = pair
+    with pytest.raises(wire.FrameTooLarge):
+        wire.send_msg(a, {"blob": "x" * 2048}, max_frame=1024)
+    a.sendall(wire._HEADER.pack(1 << 30))  # declared 1 GiB, no body
+    with pytest.raises(wire.FrameTooLarge):
+        wire.recv_msg(b, max_frame=1024)
+
+
+def test_non_dict_payload_rejected(pair):
+    a, b = pair
+    payload = b'[1, 2, 3]'
+    a.sendall(wire._HEADER.pack(len(payload)) + payload)
+    with pytest.raises(wire.WireError, match="JSON object"):
+        wire.recv_msg(b)
+
+
+def test_feats_codec_is_bitwise_for_f32_and_preserves_dtypes():
+    """f32 round-trips bitwise through JSON binary64 (every binary32 is
+    exactly representable), and int32/int8 shapes + dtypes survive — the
+    probe-trace bitwise acceptance depends on this codec being lossless."""
+    rng = np.random.default_rng(0)
+    batch = {
+        "user_id": rng.integers(0, 1 << 31 - 1, size=7, dtype=np.int32),
+        "avg_rating": rng.random(7, dtype=np.float32) * 1e-7,
+        "label": rng.integers(0, 2, size=7, dtype=np.int8),
+        "mat": rng.standard_normal((2, 3)).astype(np.float32),
+    }
+    out = wire.decode_feats(wire.encode_feats(batch))
+    assert set(out) == set(batch)
+    for k in batch:
+        assert out[k].dtype == batch[k].dtype, k
+        assert out[k].shape == batch[k].shape, k
+        np.testing.assert_array_equal(out[k], batch[k])
+
+
+def test_connect_backoff_schedule_is_the_single_retry_law(tmp_path):
+    """``wire.connect`` against a listener that does not exist yet sleeps
+    exactly the ``utils/retry.backoff_delay`` schedule (capped exponential
+    from base_ms, jitter drawn from the injected rng) — bit-for-bit the
+    delays an identically-seeded rng predicts — then surfaces the OSError
+    once the attempt budget is spent."""
+    path = tmp_path / "nobody-home.sock"
+    slept: list[float] = []
+    with pytest.raises(OSError):
+        wire.connect(path, attempts=4, base_ms=10.0, max_ms=2000.0,
+                     sleep=slept.append, rng=random.Random(13))
+    ref_rng = random.Random(13)
+    expected = [backoff_delay(i, base_delay=0.010, max_delay=2.0,
+                              rng=ref_rng) for i in range(3)]
+    assert slept == expected
+    assert len(slept) == 3  # attempts - 1 sleeps, budget respected
+
+
+def test_connect_rides_out_a_late_binding_listener(tmp_path):
+    """The supervisor's contract with a freshly-spawned child: the child
+    binds its listener late (interpreter + imports), the ingress's retry
+    schedule covers the window, and the connect succeeds without manual
+    coordination."""
+    path = tmp_path / "late.sock"
+    ready = threading.Event()
+
+    def bind_late():
+        listener = wire.listen(path)
+        ready.set()
+        conn, _ = listener.accept()
+        wire.send_msg(conn, {"type": "hello"})
+        conn.close()
+        listener.close()
+
+    t = threading.Thread(target=bind_late, daemon=True)
+
+    slept: list[float] = []
+
+    def sleep_then_bind(dt):
+        slept.append(dt)
+        if len(slept) == 2 and not t.is_alive():
+            t.start()
+            ready.wait(timeout=5)
+
+    sock = wire.connect(path, attempts=10, base_ms=1.0,
+                        sleep=sleep_then_bind, rng=random.Random(0))
+    try:
+        assert wire.recv_msg(sock) == {"type": "hello"}
+    finally:
+        sock.close()
+        t.join(timeout=5)
+    assert len(slept) >= 2  # it actually had to retry
+
+
+def test_listener_from_fd_adopts_a_prebound_socket(tmp_path):
+    """The socket-activation handoff: a listener bound by one owner keeps
+    accepting through a SECOND fd (the child's inherited copy) after the
+    first owner closes its own — connects made before the adopter even
+    existed are waiting in the backlog."""
+    import os
+
+    path = tmp_path / "activated.sock"
+    listener = wire.listen(path)
+    fd = os.dup(listener.fileno())  # what pass_fds gives the child
+    client = wire.connect(path, attempts=1)  # lands in the backlog now
+    listener.close()  # parent drops its copy; the socket stays bound
+    adopted = wire.listener_from_fd(fd)
+    try:
+        conn, _ = adopted.accept()
+        wire.send_msg(conn, {"type": "hello"})
+        assert wire.recv_msg(client) == {"type": "hello"}
+        conn.close()
+    finally:
+        client.close()
+        adopted.close()
+
+
+def test_listen_replaces_stale_socket_path(tmp_path):
+    """A SIGKILLed replica leaves its socket file behind; the respawned
+    child must bind over it (stale-path unlink) or every respawn would
+    need manual cleanup."""
+    path = tmp_path / "stale.sock"
+    first = wire.listen(path)
+    first.close()  # dies without unlinking — the kill -9 shape
+    assert path.exists()
+    second = wire.listen(path)
+    try:
+        client = wire.connect(path, attempts=1)
+        client.close()
+    finally:
+        second.close()
