@@ -965,6 +965,204 @@ def bench_cache_zipf(vocab: int = 10_131_227, dim: int = 16,
     return out
 
 
+def bench_cache_int8_zipf(vocab: int = 10_131_227, dim: int = 16,
+                          batch: int = 8192, cache_rows: int = 131_072,
+                          kind: str = "rowwise_adagrad",
+                          flush_everies: tuple[int, ...] = (1, 64),
+                          ks: tuple[int, int] = (64, 192),
+                          reps: int = 3) -> dict:
+    """:func:`bench_cache_zipf` on int8 STORAGE (the PR-18 composition the
+    planner picks for Criteo under tight HBM): the table is 1-byte codes +
+    the f32 [V, 2] (scale, offset) sidecar, cache rows mirror codes + grid,
+    every cached write requantizes per row through ``quantize_rows`` with
+    the eager path's SR key, and flush stays a bit-copy (codes scatter +
+    one sidecar scatter).  The eager baseline is the plain-int8 dedupe +
+    requantize-scatter step on the SAME power-law traffic.  vs_eager > 1 =
+    the cache wins; non-flush steps never touch the [V, d] or [V, 2]
+    arrays, so the win grows with flush_every exactly as in the f32
+    record."""
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.data.synthetic import zipf_ids
+    from tdfo_tpu.ops.quant import sr_key as make_sr_key
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer(kind, lr=1e-3)
+    out: dict[str, object] = {"vocab": vocab, "dim": dim, "batch": batch,
+                              "cache_rows": cache_rows, "optimizer": kind,
+                              "table_dtype": "int8", "zipf_a": 1.2}
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        ids = jax.device_put(zipf_ids(r, vocab, (k, batch)))
+        grads = jax.device_put(r.standard_normal((k, batch, dim), np.float32))
+        float(jnp.sum(ids) + jnp.sum(grads))
+        return (ids, grads)
+
+    def init_int8():
+        codes = jnp.zeros((vocab, dim), jnp.int8)
+        # unit grid: dequantize(0) == 0.0, matching the f32 record's zero
+        # init; training writes re-grid touched rows per row as usual
+        qs = jnp.tile(jnp.asarray([1.0, 0.0], jnp.float32), (vocab, 1))
+        return codes, qs
+
+    def run_eager(k):
+        @jax.jit
+        def chain(ids_stack, grads_stack):
+            table, qs = init_int8()
+            slots = opt.init(table)
+
+            def body(carry, xs):
+                t, s, q, step = carry
+                ids, g = xs
+                t, s, q = opt.update(
+                    t, s, ids, g, qscale=q,
+                    sr_key=make_sr_key(step, "bench_cache_int8"))
+                return (t, s, q, step + 1), None
+
+            (t, _, q, _), _ = jax.lax.scan(
+                body, (table, slots, qs, jnp.int32(0)),
+                (ids_stack, grads_stack))
+            return (t[0].astype(jnp.float32) * q[0, 0] + q[0, 1]).sum()
+
+        return chain
+
+    eager_sec = chain_time(run_eager, make_args, ks=ks, reps=reps)
+    out["eager_ms"] = round(eager_sec * 1e3, 3)
+
+    for fe in flush_everies:
+        def run_cached(k, fe=fe):
+            @jax.jit
+            def chain(ids_stack, grads_stack):
+                table, qs = init_int8()
+                slots = opt.init(table)
+                cache = opt.cache_init(table, cache_rows)
+
+                def body(carry, xs):
+                    t, s, q, c, step = carry
+                    ids, g = xs
+                    c, s = opt.cache_update(
+                        c, t, s, ids, g, step=step, qscale=q,
+                        sr_key=make_sr_key(step, "bench_cache_int8"))
+
+                    def flush(a):
+                        c, t, s, q = a
+                        c, t, s, q, _ = opt.cache_flush(c, t, s, q)
+                        return c, t, s, q
+
+                    c, t, s, q = jax.lax.cond(
+                        (step + 1) % fe == 0, flush, lambda a: a,
+                        (c, t, s, q))
+                    return (t, s, q, c, step + 1), None
+
+                (t, _, q, c, _), _ = jax.lax.scan(
+                    body,
+                    (table, slots, qs, cache, jnp.int32(0)),
+                    (ids_stack, grads_stack))
+                return ((t[0].astype(jnp.float32) * q[0, 0] + q[0, 1]).sum()
+                        + c["rows"][0].astype(jnp.float32).sum()
+                        + c["over"].astype(jnp.float32))
+
+            return chain
+
+        sec = chain_time(run_cached, make_args, ks=ks, reps=reps)
+        hit, peak = _sim_cache_hit_rate(vocab, batch, cache_rows, fe)
+        out[f"flush_every_{fe}"] = {
+            "step_ms": round(sec * 1e3, 3),
+            "hit_rate": round(hit, 4),
+            "sim_peak_dir": peak,
+            "would_overflow": peak > cache_rows,
+            "vs_eager": round(eager_sec / max(sec, 1e-9), 3),  # >1 = cache wins
+        }
+    return out
+
+
+def bench_quant_int8_fused(vocab: int = 2_000_000, dim: int = 64,
+                           batch: int = 8192, kind: str = "adam",
+                           ks: tuple[int, int] = (16, 64),
+                           reps: int = 3) -> dict:
+    """The other PR-18 composition: fused int8 byte-container fat lines
+    (codes + bitcast (scale, offset) sidecar + f32 optimizer state in ONE
+    line) vs the plain-int8 dedupe + requantize-scatter step, full update
+    chain at the wide-row profile where the fat line wins on BOTH axes
+    (d=64 adam: 640 B/row fused vs 1160 plain, one DMA stream vs three
+    scatters + a sidecar scatter).  vs_plain > 1 = fused wins.  The two
+    trajectories are bit-identical by construction (tests pin it); this
+    record prices the layout choice the planner makes."""
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.ops.pallas_kernels import fat_pack
+    from tdfo_tpu.ops.quant import quantize_rows, sr_key as make_sr_key
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+    from tdfo_tpu.plan.costs import table_hbm_bytes
+
+    opt = sparse_optimizer(kind, lr=1e-2, small_vocab_threshold=0)
+    out: dict[str, object] = {
+        "vocab": vocab, "dim": dim, "batch": batch, "optimizer": kind,
+        "hbm_bytes_fused": table_hbm_bytes(vocab, dim, optimizer=kind,
+                                           dtype="int8", fused=True),
+        "hbm_bytes_plain": table_hbm_bytes(vocab, dim, optimizer=kind,
+                                           dtype="int8", fused=False),
+    }
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        ids = jax.device_put(r.integers(0, vocab, (k, batch)).astype(np.int32))
+        grads = jax.device_put(
+            r.standard_normal((k, batch, dim), np.float32))
+        float(jnp.sum(ids) + jnp.sum(grads))
+        return (jax.random.key(seed), ids, grads)
+
+    def run_fused(k):
+        @jax.jit
+        def chain(key, ids_stack, grads_stack):
+            fat = fat_pack(jax.random.uniform(key, (vocab, dim)),
+                           dtype=jnp.int8, kind=kind)
+            slots = opt.init(fat)
+
+            def body(carry, xs):
+                t, s, step = carry
+                ids, g = xs
+                t, s = opt.update(t, s, ids, g, embedding_dim=dim,
+                                  sr_key=make_sr_key(step, "bench_qfused"))
+                return (t, s, step + 1), None
+
+            (t, _, _), _ = jax.lax.scan(body, (fat, slots, jnp.int32(0)),
+                                        (ids_stack, grads_stack))
+            return t[0, 0, :dim].astype(jnp.float32).sum()
+
+        return chain
+
+    def run_plain(k):
+        @jax.jit
+        def chain(key, ids_stack, grads_stack):
+            codes, qs = quantize_rows(jax.random.uniform(key, (vocab, dim)))
+            slots = opt.init(codes)
+
+            def body(carry, xs):
+                t, s, q, step = carry
+                ids, g = xs
+                t, s, q = opt.update(t, s, ids, g, qscale=q,
+                                     sr_key=make_sr_key(step, "bench_qfused"))
+                return (t, s, q, step + 1), None
+
+            (t, _, q, _), _ = jax.lax.scan(
+                body, (codes, slots, qs, jnp.int32(0)),
+                (ids_stack, grads_stack))
+            return (t[0].astype(jnp.float32) * q[0, 0] + q[0, 1]).sum()
+
+        return chain
+
+    fused_sec = chain_time(run_fused, make_args, ks=ks, reps=reps)
+    plain_sec = chain_time(run_plain, make_args, ks=ks, reps=reps)
+    out["fused_ms"] = round(fused_sec * 1e3, 3)
+    out["plain_ms"] = round(plain_sec * 1e3, 3)
+    out["vs_plain"] = round(plain_sec / max(fused_sec, 1e-9), 3)
+    return out
+
+
 def bench_serving(batch_size: int = 8192, embed_dim: int = 64,
                   top_k: int = 100) -> dict:
     """Serving-path latency: the frontend's jitted scoring program at its
@@ -1328,6 +1526,12 @@ def main() -> None:
     ap.add_argument("--skip-cache", action="store_true",
                     help="skip the update-cache amortization record "
                          "(cache_zipf)")
+    ap.add_argument("--skip-cache-int8", action="store_true",
+                    help="skip the int8-storage update-cache record "
+                         "(cache_int8_zipf)")
+    ap.add_argument("--skip-quant-fused", action="store_true",
+                    help="skip the fused-int8 fat-line vs plain-int8 "
+                         "record (quant_int8_fused)")
     ap.add_argument("--skip-planner", action="store_true",
                     help="dlrm-criteo only: skip the planner-vs-defaults "
                          "record (planner_dlrm8)")
@@ -1454,6 +1658,20 @@ def main() -> None:
         except Exception as e:  # cache record must never kill the headline
             print(f"bench: cache bench failed: {e!r}", file=sys.stderr)
 
+    cache_int8_zipf = {}
+    if on_tpu and not args.skip_cache_int8 and not args.dense:
+        try:
+            cache_int8_zipf = bench_cache_int8_zipf()
+        except Exception as e:  # cache record must never kill the headline
+            print(f"bench: int8-cache bench failed: {e!r}", file=sys.stderr)
+
+    quant_int8_fused = {}
+    if on_tpu and not args.skip_quant_fused and not args.dense:
+        try:
+            quant_int8_fused = bench_quant_int8_fused()
+        except Exception as e:  # quant record must never kill the headline
+            print(f"bench: fused-int8 bench failed: {e!r}", file=sys.stderr)
+
     retrieval_scale = {}
     if on_tpu and not args.skip_retrieval_scale and not args.dense:
         try:
@@ -1522,6 +1740,8 @@ def main() -> None:
         "serving": serving,
         "serve_fleet8": serve_fleet,
         "cache_zipf": cache_zipf,
+        "cache_int8_zipf": cache_int8_zipf,
+        "quant_int8_fused": quant_int8_fused,
         "retrieve_twostage8": retrieval_scale,
         "planner_dlrm8": planner_rec,
         "trace_overhead": trace_overhead,

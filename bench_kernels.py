@@ -184,6 +184,93 @@ def bench_fat_bf16(v: int = 2_000_000, d: int = 64, b: int = 8192) -> dict:
     }
 
 
+def bench_fat_int8(v: int = 2_000_000, d: int = 64, b: int = 8192) -> dict:
+    """int8 byte-container fat lines (1-byte codes + the bitcast f32
+    (scale, offset) sidecar + f32 adam state in ONE line: 640 B/row at
+    d=64 vs 1160 B/row for plain int8 codes + sidecar + f32 slot arrays)
+    vs the f32 fat tier AND the plain-int8 dedupe + scatter path on
+    identical updates.  vs_baseline > 1 means the int8 fat line wins over
+    f32 fat; vs_plain_int8 > 1 means it also beats the eager plain-int8
+    scatter — the planner's cross-over at this profile (docs/BUDGET.md)."""
+    from tdfo_tpu.ops.pallas_kernels import fat_pack
+    from tdfo_tpu.ops.quant import quantize_rows, sr_key as make_sr_key
+    from tdfo_tpu.ops.sparse import sparse_optimizer
+
+    opt = sparse_optimizer("adam", lr=1e-2, small_vocab_threshold=0)
+    probe = jax.random.normal(jax.random.key(9), (d,))
+
+    def build_fat(dtype):
+        quant = dtype != jnp.float32
+
+        def run(k):
+            @jax.jit
+            def chain(key, ids_stack, grads_stack):
+                table = jax.random.uniform(key, (v, d), jnp.float32)
+                fat = fat_pack(table, jnp.zeros((v, d), jnp.float32),
+                               jnp.zeros((v, d), jnp.float32), dtype=dtype)
+                slots = opt.init(fat)
+
+                def body(carry, xs):
+                    t, s, step = carry
+                    ids, g = xs
+                    sk = make_sr_key(step, "bench_fat") if quant else None
+                    t, s = opt.update(t, s, ids, g, embedding_dim=d,
+                                      sr_key=sk)
+                    return (t, s, step + 1), None
+
+                (t, _, _), _ = jax.lax.scan(
+                    body, (fat, slots, jnp.int32(0)),
+                    (ids_stack, grads_stack))
+                return (t[0, 0, :d].astype(jnp.float32) @ probe).sum()
+
+            return chain
+
+        return run
+
+    def run_plain(k):
+        @jax.jit
+        def chain(key, ids_stack, grads_stack):
+            codes, qs = quantize_rows(
+                jax.random.uniform(key, (v, d), jnp.float32))
+            slots = opt.init(codes)
+
+            def body(carry, xs):
+                t, s, q, step = carry
+                ids, g = xs
+                t, s, q = opt.update(t, s, ids, g,
+                                     sr_key=make_sr_key(step, "bench_fat"),
+                                     qscale=q)
+                return (t, s, q, step + 1), None
+
+            (t, _, q, _), _ = jax.lax.scan(
+                body, (codes, slots, qs, jnp.int32(0)),
+                (ids_stack, grads_stack))
+            return ((t[0].astype(jnp.float32) * q[0, 0] + q[0, 1])
+                    @ probe).sum()
+
+        return chain
+
+    def make_args(k, seed):
+        r = np.random.default_rng(seed)
+        ids = jax.device_put(r.integers(0, v, (k, b)).astype(np.int32))
+        grads = jax.device_put(r.standard_normal((k, b, d), np.float32))
+        float(jnp.sum(ids) + jnp.sum(grads))
+        return (jax.random.key(seed), ids, grads)
+
+    i8_sec = _chain_time(build_fat(jnp.int8), make_args)
+    f32_sec = _chain_time(build_fat(jnp.float32), make_args)
+    plain_sec = _chain_time(run_plain, make_args)
+    return {
+        "metric": f"fat_adam_int8_V{v}_B{b}_D{d}_ms",
+        "value": round(i8_sec * 1e3, 3),
+        "unit": "ms",
+        "f32_fat_ms": round(f32_sec * 1e3, 3),
+        "plain_int8_ms": round(plain_sec * 1e3, 3),
+        "vs_baseline": round(f32_sec / max(i8_sec, 1e-9), 3),  # >1 = int8 faster
+        "vs_plain_int8": round(plain_sec / max(i8_sec, 1e-9), 3),
+    }
+
+
 def bench_hot_cold_update(v: int = 10_131_227, d: int = 16, b: int = 8192,
                           k_hot: int = 16_384) -> dict:
     """Frequency-partitioned update ablation at the Criteo big-table profile
@@ -439,6 +526,7 @@ if __name__ == "__main__":
     print(json.dumps(bench_flash_bwd()))
     print(json.dumps(bench_fat_adam()))
     print(json.dumps(bench_fat_bf16()))
+    print(json.dumps(bench_fat_int8()))
     print(json.dumps(bench_hot_cold_update()))
     print(json.dumps(bench_cache_route()))
     print(json.dumps(bench_ring_flash()))

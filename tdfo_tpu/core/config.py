@@ -743,18 +743,20 @@ class Config:
                 f"'bfloat16'), got {emb.slot_dtype!r}")
         _any_int8 = (emb.table_dtype == "int8"
                      or any(d == "int8" for _, d in emb.table_dtype_overrides))
-        if _any_int8 and emb.cache_rows > 0:
+        # int8 composes with the update cache (rows admitted dequantized,
+        # requantized per row at write time, codes + sidecar bit-copied at
+        # flush) and with hot/cold (the full-block one-hot update only ever
+        # touches the f32 hot HEAD; the cold residual stays row-sparse int8)
+        # — both former refusals lifted; the cache mirrors the sidecar in a
+        # "qs" buffer and hot heads dequantize at init.
+        if (_any_int8 and self.sparse_optimizer == "rowwise_adagrad"
+                and self.fused_table_threshold != -1):
             raise ValueError(
-                'table_dtype = "int8" does not compose with the update '
-                "cache (cache_rows > 0): the cache mirrors rows at storage "
-                "dtype but flushes by bit copy without the per-row "
-                "(scale, offset) sidecar")
-        if _any_int8 and emb.hot_vocab > 0:
-            raise ValueError(
-                'table_dtype = "int8" does not compose with hot/cold '
-                "storage (hot_vocab > 0): the scatter-free hot-head update "
-                "is a full-block requantize, which re-grids untouched int8 "
-                "rows")
+                'table_dtype = "int8" with sparse_optimizer = '
+                '"rowwise_adagrad" cannot use fused fat-line storage: the '
+                "f32 per-row accumulator contract cannot ride a quantized "
+                "line.  Set fused_table_threshold = -1 (disable fusing) or "
+                "pick sparse_optimizer = adagrad/adam/sgd")
         if (emb.slot_dtype == "bfloat16"
                 and self.sparse_optimizer == "rowwise_adagrad"):
             raise ValueError(
@@ -1047,8 +1049,10 @@ class Config:
             if self.embeddings.cache_rows > 0:
                 raise ValueError(
                     "planner.plan conflicts with embeddings.cache_rows > 0: "
-                    "the plan prices the update cache itself (and BUDGET.md "
-                    "prices it pessimistically — plans emit cache_rows 0)")
+                    "the plan prices the update cache itself and carries "
+                    "its own cache_rows/cache_flush_every decision (> 0 "
+                    "only for plain-int8 plans where the model predicts a "
+                    "win)")
             if (self.embeddings.table_dtype != "float32"
                     or self.embeddings.slot_dtype != "float32"
                     or self.embeddings.table_dtype_overrides):

@@ -44,6 +44,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tdfo_tpu.ops.quant import (
+    bytes_to_f32, dequantize_rows, f32_to_bytes, quantize_rows)
+
 # jax < 0.5 ships the same dataclass under the TPU-prefixed name
 _CompilerParams = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
@@ -434,17 +437,32 @@ _STATE_LANES = {
 
 @dataclass(frozen=True)
 class LineLayout:
-    """Static description of a packed fat-line table for (d, kind)."""
+    """Static description of a packed fat-line table for (d, kind, dtype).
+
+    ``dtype == "int8"`` describes the BYTE-container line: an int8 [L, T,
+    128] array whose per-row slot packs ``[d code bytes | 8 sidecar bytes
+    (bitcast f32 scale, offset) | 4 bytes per f32 state lane]``.  Only the
+    d table lanes are quantized — the rowwise (scale, offset) pair and the
+    optimizer state ride as EXACT f32 bit patterns, so fused-int8 state math
+    is bit-identical to the plain-int8 (f32 slots) reference."""
 
     d: int
     kind: str
     w: int      # lanes per vocab row (slot width): [table(d) | state | pad]
     r: int      # vocab rows per line (r * w == tiles * 128)
     tiles: int  # trailing [tiles, 128] shape per line
+    dtype: str = "float32"
+
+    @property
+    def state_lanes(self) -> int:
+        return _STATE_LANES[self.kind](self.d)
 
     @property
     def need(self) -> int:
-        return self.d + _STATE_LANES[self.kind](self.d)
+        if self.dtype == "int8":
+            # codes + bitcast f32 (scale, offset) + bitcast f32 state
+            return self.d + 8 + 4 * self.state_lanes
+        return self.d + self.state_lanes
 
     def n_lines(self, rows: int) -> int:
         return -(-rows // self.r)
@@ -453,9 +471,22 @@ class LineLayout:
         return self.n_lines(rows) * self.r
 
 
-def line_layout(d: int, kind: str) -> LineLayout:
+def line_layout(d: int, kind: str, dtype="float32") -> LineLayout:
     if kind not in _STATE_LANES:
         raise ValueError(f"unknown fused optimizer kind: {kind!r}")
+    dt = jnp.dtype(dtype)
+    if dt == jnp.int8:
+        if kind == "rowwise_adagrad":
+            raise ValueError(
+                "fused int8 storage does not support rowwise_adagrad: the "
+                "f32 per-row accumulator contract cannot ride a quantized "
+                "line (use optimizer = adagrad/adam/sgd, or fused = false)")
+        need = d + 8 + 4 * _STATE_LANES[kind](d)
+        if need <= _LANE:
+            w = next(s for s in _SLOT_WIDTHS if s >= need)
+            return LineLayout(d, kind, w, _LANE // w, 1, "int8")
+        tiles = -(-need // _LANE)
+        return LineLayout(d, kind, tiles * _LANE, 1, tiles, "int8")
     need = d + _STATE_LANES[kind](d)
     if need <= _LANE:
         w = next(s for s in _SLOT_WIDTHS if s >= need)
@@ -483,6 +514,20 @@ def fat_gather_rows(fat: jax.Array, ids: jax.Array, layout: LineLayout) -> jax.A
     clip every other lookup path uses."""
     ids = jnp.maximum(ids, 0)
     lines = jnp.take(fat, ids // layout.r, axis=0)  # [..., T, 128]
+    if layout.dtype == "int8":
+        # slot-select codes AND the adjacent 8 sidecar bytes, then decode
+        # on the small gathered block (the table itself stays byte-packed)
+        span = layout.d + 8
+        flat = lines.reshape(*lines.shape[:-2], layout.tiles * _LANE)
+        out = flat[..., :span]
+        if layout.r > 1:
+            slot = ids % layout.r
+            for s in range(1, layout.r):
+                piece = flat[..., s * layout.w: s * layout.w + span]
+                out = jnp.where((slot == s)[..., None], piece, out)
+        codes = out[..., : layout.d]
+        qs = bytes_to_f32(out[..., layout.d: span])
+        return dequantize_rows(codes, qs)
     if layout.r == 1 and layout.d <= _LANE:
         # table lanes live wholly in tile 0: slice without the flattening
         # reshape (which costs a relayout of the gathered block)
@@ -499,7 +544,8 @@ def fat_gather_rows(fat: jax.Array, ids: jax.Array, layout: LineLayout) -> jax.A
 
 
 def fat_pack(table: jax.Array, *state: jax.Array, kind: str = "adam",
-             layout: LineLayout | None = None, dtype=None) -> jax.Array:
+             layout: LineLayout | None = None, dtype=None,
+             qscale: jax.Array | None = None) -> jax.Array:
     """[V, d] table (+ per-kind optimizer state) -> [L, T, 128] fat lines.
 
     State arguments by kind: adam ``(mu[V,d], nu[V,d])``; adagrad
@@ -512,13 +558,43 @@ def fat_pack(table: jax.Array, *state: jax.Array, kind: str = "adam",
     but packs the optimizer state at bf16 too, which is why fused
     rowwise_adagrad (f32-per-row accumulator contract) rejects bf16
     upstream (``parallel/embedding.py``).
+
+    ``dtype == int8`` builds the byte-container line (:class:`LineLayout`):
+    an f32 ``table`` is rowwise-quantized here (round-to-nearest, the same
+    grid plain-int8 init uses); an int8 ``table`` of codes requires its
+    ``qscale`` f32 [V, 2] sidecar.  State must be f32 — it rides as exact
+    bit patterns, never quantized.
     """
     v, d = table.shape
-    lay = layout or line_layout(d, kind)
     dt = jnp.dtype(dtype) if dtype is not None else table.dtype
+    lay = layout or line_layout(d, kind, dt)
     want = {"sgd": 0, "rowwise_adagrad": 1, "adagrad": 1, "adam": 2}[lay.kind]
     if state and len(state) != want:
         raise ValueError(f"{lay.kind} fat_pack takes {want} state arrays")
+    if dt == jnp.int8:
+        if jnp.dtype(table.dtype) == jnp.int8:
+            if qscale is None:
+                raise ValueError(
+                    "fat_pack of int8 codes needs the f32 (scale, offset) "
+                    "qscale sidecar")
+            codes, qs = table, qscale.astype(jnp.float32)
+        else:
+            codes, qs = quantize_rows(table.astype(jnp.float32))
+        comps = [codes, f32_to_bytes(qs)]
+        if lay.kind == "adagrad":
+            acc = state[0] if state else jnp.zeros((v, d), jnp.float32)
+            comps.append(f32_to_bytes(acc.astype(jnp.float32)))
+        elif lay.kind == "adam":
+            mu = state[0] if state else jnp.zeros((v, d), jnp.float32)
+            nu = state[1] if len(state) > 1 else jnp.zeros((v, d), jnp.float32)
+            comps += [f32_to_bytes(mu.astype(jnp.float32)),
+                      f32_to_bytes(nu.astype(jnp.float32))]
+        if lay.w > lay.need:
+            comps.append(jnp.zeros((v, lay.w - lay.need), codes.dtype))
+        rows = jnp.concatenate(comps, axis=1)
+        pad = lay.padded_rows(v) - v
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+        return rows.reshape(-1, lay.tiles, _LANE)
     comps = [table.astype(dt)]
     if lay.kind == "rowwise_adagrad":
         acc = state[0] if state else jnp.zeros((v,), dt)
@@ -540,12 +616,23 @@ def fat_pack(table: jax.Array, *state: jax.Array, kind: str = "adam",
 
 def fat_unpack(fat: jax.Array, layout: LineLayout,
                rows: int | None = None) -> tuple[jax.Array, ...]:
-    """Inverse of :func:`fat_pack`: ``(table[V,d], *state)``."""
+    """Inverse of :func:`fat_pack`: ``(table[V,d], *state)``.  int8 lines
+    return ``(codes[V,d] int8, qscale[V,2] f32, *state f32)`` — the same
+    (codes, sidecar) pair the plain-int8 layout stores in two arrays."""
     view = fat_view(fat, layout)
     if rows is not None:
         view = view[:rows]
     d = layout.d
     table = view[:, :d]
+    if layout.dtype == "int8":
+        qs = bytes_to_f32(view[:, d:d + 8])
+        if layout.kind == "sgd":
+            return table, qs
+        if layout.kind == "adagrad":
+            return table, qs, bytes_to_f32(view[:, d + 8:d + 8 + 4 * d])
+        return (table, qs,
+                bytes_to_f32(view[:, d + 8:d + 8 + 4 * d]),
+                bytes_to_f32(view[:, d + 8 + 4 * d:d + 8 + 8 * d]))
     if layout.kind == "sgd":
         return (table,)
     if layout.kind == "rowwise_adagrad":

@@ -34,8 +34,10 @@ import jax.numpy as jnp
 __all__ = [
     "QSCALE_LAYOUT",
     "STORAGE_DTYPES",
+    "bytes_to_f32",
     "component_key",
     "dequantize_rows",
+    "f32_to_bytes",
     "quantize",
     "quantize_rows",
     "sr_key",
@@ -125,10 +127,14 @@ def quantize(x: jax.Array, dtype, key: jax.Array | None = None) -> jax.Array:
 #
 # Unlike bf16, int8 stochastic rounding is NOT identity on stored values:
 # every write recomputes the row's grid from the NEW f32 values, so codes
-# shift even for untouched lanes of a touched row.  Untouched ROWS are
-# never rewritten (the sparse optimizers scatter only gathered rows), which
-# is why int8 is refused on the full-block requantize paths (dense_lazy
-# one-hot tier, fat-line storage, the update cache).
+# shift even for untouched lanes of a touched row.  Untouched ROWS must
+# therefore never be rewritten: every int8 write path is ROW-sparse
+# (per-row scatter of gathered rows), including the layout compositions —
+# fat-line int8 carries codes + sidecar + f32-byte optimizer state in one
+# byte line and updates it per row (``ops/sparse._fat_apply_rows_int8``),
+# and the update cache requantizes per cached row at write time and
+# bit-copies codes + sidecar at flush.  The one full-block sweep in the
+# tree (the dense_lazy one-hot tier) stays f32/bf16-only.
 
 
 def quantize_rows(
@@ -154,6 +160,26 @@ def quantize_rows(
         q = jnp.floor(t + jax.random.uniform(key, x.shape, jnp.float32))
     data = jnp.clip(q, -128.0, 127.0).astype(jnp.int8)
     return data, jnp.concatenate([scale, offset], axis=-1)
+
+
+def f32_to_bytes(x: jax.Array) -> jax.Array:
+    """f32 ``[..., K]`` -> int8 ``[..., 4*K]`` byte view (pure bitcast, no
+    rounding).  The int8 fat-line layout stores the per-row (scale, offset)
+    sidecar and the exact f32 optimizer state as byte lanes of the int8
+    line; this helper (and :func:`bytes_to_f32`) keeps every int8-typed
+    cast in this module — ``tests/test_quality.py`` enforces the monopoly."""
+    b = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.int8)
+    return b.reshape(*x.shape[:-1], x.shape[-1] * 4)
+
+
+def bytes_to_f32(b: jax.Array) -> jax.Array:
+    """int8 ``[..., 4*K]`` byte view -> f32 ``[..., K]`` (inverse of
+    :func:`f32_to_bytes`; exact round-trip, bits untouched)."""
+    if b.shape[-1] % 4:
+        raise ValueError(f"byte lane count {b.shape[-1]} is not a multiple of 4")
+    k = b.shape[-1] // 4
+    return jax.lax.bitcast_convert_type(
+        b.reshape(*b.shape[:-1], k, 4), jnp.float32)
 
 
 def dequantize_rows(data: jax.Array, qscale: jax.Array) -> jax.Array:

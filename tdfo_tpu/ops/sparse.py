@@ -470,17 +470,23 @@ def _replicated_shard_map(f, mesh):
 
 def cache_lookup_rows(cache, ids, *, mesh=None):
     """Route ``ids`` and gather their cached rows: ``(rows[..., d],
-    hit[...])``.  Pass the device ``mesh`` from inside multi-device jitted
-    programs so the route runs replicated (see
-    :func:`_replicated_shard_map`); the gathered rows come back replicated
-    and mix freely with sharded activations."""
-    def f(cids, cslot, crows, q):
+    hit[...])``.  int8 caches (a ``qs`` (scale, offset) mirror present)
+    return the rows DEQUANTIZED through the cached per-row grid — callers
+    always see f32 values, same as the big-table lookup path.  Pass the
+    device ``mesh`` from inside multi-device jitted programs so the route
+    runs replicated (see :func:`_replicated_shard_map`); the gathered rows
+    come back replicated and mix freely with sharded activations."""
+    def f(cids, cslot, crows, q, *qs):
         phys, hit = cache_route({"ids": cids, "slot": cslot}, q)
-        cur = jnp.take(crows, jnp.minimum(phys, crows.shape[0] - 1), axis=0)
+        clamp = jnp.minimum(phys, crows.shape[0] - 1)
+        cur = jnp.take(crows, clamp, axis=0)
+        if qs:
+            cur = dequantize_rows(cur, jnp.take(qs[0], clamp, axis=0))
         return cur, hit
     if mesh is not None:
         f = _replicated_shard_map(f, mesh)
-    return f(cache["ids"], cache["slot"], cache["rows"], ids)
+    qs_ops = (cache["qs"],) if "qs" in cache else ()
+    return f(cache["ids"], cache["slot"], cache["rows"], ids, *qs_ops)
 
 
 def cache_overlay_rows(cache, ids, rows, *, mesh=None):
@@ -512,15 +518,17 @@ def _cache_gather_slot(key, slots, kind, src):
     return jnp.take(slots[big], src, axis=0)
 
 
-def _cache_admit(cache, urows, uslot, uids, valid, kind, step):
+def _cache_admit(cache, urows, uslot, uids, valid, kind, step, uqs=None):
     """Admit every missing valid ``uid``: assign free physical slots, copy
     the authoritative rows + slot mirrors from the PRE-GATHERED per-uid
     blocks (``urows[U, d]`` / ``uslot`` — the big arrays never enter: their
     gathers happen outside, where GSPMD partitions plain gathers
-    correctly), and re-sort the directory.  Distinct ids past the free
-    capacity are counted into the ``over`` counter — their updates would be
-    silently lost, so callers must treat a non-zero counter as a hard
-    error."""
+    correctly), and re-sort the directory.  int8 caches also bit-copy the
+    per-row (scale, offset) pairs (``uqs``, gathered from the table's
+    sidecar) into the ``qs`` mirror — admission copies bits, it never
+    re-grids.  Distinct ids past the free capacity are counted into the
+    ``over`` counter — their updates would be silently lost, so callers
+    must treat a non-zero counter as a hard error."""
     c = cache["ids"].shape[0]
     cids, cslot = cache["ids"], cache["slot"]
     _, hit = cache_route(cache, uids)
@@ -550,6 +558,9 @@ def _cache_admit(cache, urows, uslot, uids, valid, kind, step):
     cache["ids"], cache["slot"] = sids, sslot
     cache["rows"] = cache["rows"].at[tgt].set(
         jnp.take(urows, upos, axis=0), mode="drop")
+    if uqs is not None:
+        cache["qs"] = cache["qs"].at[tgt].set(
+            jnp.take(uqs, upos, axis=0), mode="drop")
     for key in _cache_mirror_keys(kind):
         cache[key] = cache[key].at[tgt].set(
             jnp.take(uslot[key], upos, axis=0), mode="drop")
@@ -700,6 +711,90 @@ def dedupe_rows_and_lines(ids, *, capacity_rows: int, capacity_lines: int,
     return seg_row, ulines, row_lidx, row_slot
 
 
+def _fat_apply_rows_int8(fat, uids, g, *, layout, lr, b1=0.9, b2=0.999,
+                         eps=1e-8, weight_decay=0.0, new_count=None,
+                         sr_key=None):
+    """ROW-space optimizer step on int8 byte-container fat lines.
+
+    The line-space XLA formulation cannot serve int8: ``quantize_rows``'
+    stochastic draw covers the whole operand block, so bit-parity with the
+    plain-int8 reference requires calling it on the SAME ``[U, d]``
+    uids-ordered block with the SAME key — which is exactly what this
+    function does.  Gather the touched byte rows through the ``[L*R, W]``
+    view, decode (codes x sidecar -> f32 rows, state bytes -> exact f32),
+    run the ``sparse_*``-identical math, requantize the new rows
+    (:func:`quantize_rows`, fbgemm rowwise requantize semantics — raw key
+    for sgd, ``component_key(key, 0)`` otherwise, mirroring
+    :func:`_requantize_scatter` callers), re-encode, scatter the rows back.
+    Sentinel uids (int32 max) clamp on the gather and drop on the scatter.
+    The flattening view reshape materialises on TPU (docs/BUDGET.md prices
+    it); the in-place DMA kernel does not cover int8 lines yet."""
+    from tdfo_tpu.ops.pallas_kernels import fat_view
+    from tdfo_tpu.ops.quant import bytes_to_f32, f32_to_bytes
+
+    d = layout.d
+    view = fat_view(fat, layout)
+    safe = jnp.minimum(jnp.maximum(uids, 0), view.shape[0] - 1)
+    rows_b = jnp.take(view, safe, axis=0)  # [U, W] bytes
+    codes = rows_b[:, :d]
+    qs = bytes_to_f32(rows_b[:, d:d + 8])
+    rows = dequantize_rows(codes, qs)
+    g = g.astype(jnp.float32)
+    kind = layout.kind
+    if kind == "sgd":
+        g2 = g + weight_decay * rows
+        new_rows = rows - lr * g2
+        key_t = sr_key  # sparse_sgd passes the raw step key
+        state_new = ()
+    elif kind == "adagrad":
+        acc = bytes_to_f32(rows_b[:, d + 8:d + 8 + 4 * d])
+        g2 = g + weight_decay * rows
+        acc_n = acc + g2 * g2
+        delta = lr * g2 / (jnp.sqrt(acc_n) + eps)
+        new_rows = rows - delta
+        key_t = component_key(sr_key, 0)
+        state_new = (acc_n,)
+    elif kind == "adam":
+        mu = bytes_to_f32(rows_b[:, d + 8:d + 8 + 4 * d])
+        nu = bytes_to_f32(rows_b[:, d + 8 + 4 * d:d + 8 + 8 * d])
+        t = new_count.astype(jnp.float32)
+        mu_n = b1 * mu + (1 - b1) * g
+        nu_n = b2 * nu + (1 - b2) * g * g
+        mu_hat = mu_n / (1 - b1**t)
+        nu_hat = nu_n / (1 - b2**t)
+        delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + weight_decay * rows)
+        new_rows = rows - delta
+        key_t = component_key(sr_key, 0)
+        state_new = (mu_n, nu_n)
+    else:  # rowwise_adagrad never builds an int8 layout (line_layout refuses)
+        raise ValueError(kind)
+    new_codes, new_qs = quantize_rows(new_rows, key_t)
+    comps = [new_codes, f32_to_bytes(new_qs)]
+    comps += [f32_to_bytes(s) for s in state_new]
+    if layout.w > layout.need:
+        comps.append(rows_b[:, layout.need:])  # preserve the zero pad bytes
+    new_b = jnp.concatenate(comps, axis=1)
+    return view.at[uids].set(new_b, mode="drop").reshape(fat.shape)
+
+
+def _fat_apply_int8(fat, slots, uids, g, *, layout, lr, b1, b2, eps,
+                    weight_decay, sr_key=None):
+    """Slot bookkeeping around :func:`_fat_apply_rows_int8` (adam's global
+    bias-correction count is the only out-of-line state).  Returns
+    ``(fat, slots)``."""
+    if layout.kind == "adam":
+        (count,) = slots
+        new_count = count + 1
+        new_slots = (new_count,)
+    else:
+        new_count = None
+        new_slots = slots
+    fat = _fat_apply_rows_int8(
+        fat, uids, g, layout=layout, lr=lr, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, new_count=new_count, sr_key=sr_key)
+    return fat, new_slots
+
+
 def _kernel_seed(sr_key, dtype):
     """Scalar int32 stochastic-rounding seed for the fat-line kernels
     (None = no SR: f32 storage, or no key -> round-to-nearest)."""
@@ -723,10 +818,23 @@ def fat_apply_routed(fat, slots, ulines, g_u, row_lidx, row_slot, lines, *,
         line_layout,
     )
 
-    layout = line_layout(embedding_dim, kind)
+    layout = line_layout(embedding_dim, kind, fat.dtype)
     r = layout.r
     cl = ulines.shape[0]
     cr = g_u.shape[0]
+    if layout.dtype == "int8":
+        # reconstruct the sorted distinct ROW ids from the routing arrays
+        # (uids order == the plain path's dedupe rank order, which is what
+        # makes the requantize draw bit-identical); slots past the real
+        # lines keep the int32-max sentinel so their writes drop
+        oob = jnp.iinfo(jnp.int32).max
+        uids = jnp.where(
+            row_lidx < cl,
+            jnp.take(ulines, jnp.minimum(row_lidx, cl - 1)) * r + row_slot,
+            oob)
+        return _fat_apply_int8(
+            fat, slots, uids, g_u, layout=layout, lr=lr, b1=b1, b2=b2,
+            eps=eps, weight_decay=weight_decay, sr_key=sr_key)
     if kind == "adam":
         (count,) = slots
         new_count = count + 1
@@ -861,7 +969,11 @@ def fat_apply_unique(fat, slots, uids, g, valid=None, *, embedding_dim, kind,
     """
     from tdfo_tpu.ops.pallas_kernels import line_layout
 
-    layout = line_layout(embedding_dim, kind)
+    layout = line_layout(embedding_dim, kind, fat.dtype)
+    if layout.dtype == "int8":
+        return _fat_apply_int8(
+            fat, slots, uids, g, layout=layout, lr=lr, b1=b1, b2=b2,
+            eps=eps, weight_decay=weight_decay, sr_key=sr_key)
     if valid is None:
         valid = uids < jnp.iinfo(jnp.int32).max
     ulines, g_slots, touched = _lines_from_unique(uids, g, valid, layout)
@@ -884,13 +996,22 @@ def fat_update(fat, slots, ids, grads, *, embedding_dim, kind, lr, b1=0.9,
     One line-aware dedupe sort + one segment-sum produce the kernel
     operands directly (no row-level intermediate).  ``capacity`` /
     ``max_distinct`` bound distinct LINES here (a row bound is always a
-    valid line bound).  Returns ``(fat, slots)``."""
+    valid line bound); int8 byte-container lines dedupe in ROW space
+    instead (the row-sparse requantize contract), so there they bound
+    distinct rows.  Returns ``(fat, slots)``."""
     from tdfo_tpu.ops.pallas_kernels import line_layout
 
-    layout = line_layout(embedding_dim, kind)
+    layout = line_layout(embedding_dim, kind, fat.dtype)
     r = layout.r
     ids = ids.reshape(-1)
     grads = grads.reshape(-1, grads.shape[-1])
+    if layout.dtype == "int8":
+        uids, g, _valid = dedupe_grads(
+            ids, grads, capacity=capacity, vocab=fat.shape[0] * r,
+            max_distinct=max_distinct)
+        return _fat_apply_int8(
+            fat, slots, uids, g, layout=layout, lr=lr, b1=b1, b2=b2,
+            eps=eps, weight_decay=weight_decay, sr_key=sr_key)
     ulines, seg, valid = dedupe_ids(
         ids, capacity=capacity, vocab=fat.shape[0] * r,
         max_distinct=max_distinct, rows_per_line=r,
@@ -987,13 +1108,16 @@ class SparseOptimizer:
         """Tier dispatch on PRE-deduplicated ``(uids, g, valid)`` — the
         dedup-lookup step path (one shared sort per array per step).  The
         small-vocab one-hot tier needs raw ids and is bypassed here;
-        ``sparse_adam`` has identical semantics.  int8 tables pass their
-        (scale, offset) sidecar as ``qscale`` and get ``(table, slots,
-        qscale)`` back (plain 2D storage only — int8 never rides fat
-        lines)."""
+        ``sparse_adam`` has identical semantics.  PLAIN 2D int8 tables pass
+        their (scale, offset) sidecar as ``qscale`` and get ``(table,
+        slots, qscale)`` back; int8 FAT-LINE tables carry the sidecar
+        in-line (byte-container layout) and never take a ``qscale``."""
         if table.ndim == 3:
             if qscale is not None:
-                raise ValueError("int8 tables do not ride fat-line storage")
+                raise ValueError(
+                    "fat-line int8 tables carry their (scale, offset) "
+                    "sidecar in-line — qscale is only for plain 2D int8 "
+                    "tables")
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
             return fat_apply_unique(
@@ -1084,7 +1208,10 @@ class SparseOptimizer:
         """Empty update-cache pytree for a plain 2D ``table``: sorted-id
         directory (+ its physical-slot permutation), value rows at the
         table's storage dtype, per-kind optimizer-slot mirrors, dirty mask,
-        frequency/recency counters, and the admission-overflow counter."""
+        frequency/recency counters, and the admission-overflow counter.
+        int8 tables add a ``qs`` f32 [C, 2] (scale, offset) mirror: cached
+        rows store CODES at storage dtype plus their per-row grid, so flush
+        stays a bit-copy."""
         if table.ndim != 2:
             raise ValueError(
                 "the update cache covers plain 2D tables only (fat-line "
@@ -1100,23 +1227,31 @@ class SparseOptimizer:
             "last": jnp.zeros((c,), jnp.int32),
             "over": jnp.zeros((), jnp.int32),
         }
+        if jnp.dtype(table.dtype) == jnp.int8:
+            cache["qs"] = jnp.zeros((c, 2), jnp.float32)
         for key in _cache_mirror_keys(self.kind):
             cache[key] = _cache_slot_mirror(key, self.kind, c, d,
                                             self.slot_dtype)
         return cache
 
     def cache_update_unique(self, cache, table, slots, uids, g, valid, *,
-                            step, sr_key=None, mesh=None):
+                            step, sr_key=None, mesh=None, qscale=None):
         """Cached step on PRE-deduplicated ``(uids, g, valid)``: admit
         misses (gather-only), then apply the EXACT per-row ``sparse_*``
         math to the cached rows/mirrors and scatter into the [C] cache —
         the big table and its slot row arrays are read, never written.
         ``step`` feeds the recency counter.  Returns ``(cache, slots)``
-        (``slots`` changes only for adam's global step count).  Pass the
-        device ``mesh`` when calling from inside a multi-device jitted
-        program: the cache math then runs in a fully-replicated
-        ``shard_map`` (see :func:`_replicated_shard_map`) while the big
-        table/slot gathers stay outside on the sharded arrays."""
+        (``slots`` changes only for adam's global step count).  int8
+        tables pass their (scale, offset) sidecar as ``qscale``: admission
+        bit-copies codes + grid, the math dequantizes through the cached
+        grid, and every write requantizes the NEW rows via
+        :func:`quantize_rows` with the same key discipline as
+        :func:`_requantize_scatter` callers — so the cached trajectory is
+        bit-identical to the eager plain-int8 one.  Pass the device
+        ``mesh`` when calling from inside a multi-device jitted program:
+        the cache math then runs in a fully-replicated ``shard_map`` (see
+        :func:`_replicated_shard_map`) while the big table/slot gathers
+        stay outside on the sharded arrays."""
         if counters.enabled():
             # pre-admission route: how many of this step's unique rows the
             # cache already held.  Gather-only on replicated cache arrays,
@@ -1128,6 +1263,7 @@ class SparseOptimizer:
         # which GSPMD partitions correctly on sharded tables
         gid = jnp.minimum(jnp.where(valid, uids, 0), table.shape[0] - 1)
         urows = jnp.take(table, gid, axis=0)
+        uqs = None if qscale is None else jnp.take(qscale, gid, axis=0)
         uslot = {key: _cache_gather_slot(key, slots, self.kind, gid)
                  for key in _cache_mirror_keys(self.kind)}
         count = slots[2] if self.kind == "adam" else None
@@ -1135,40 +1271,56 @@ class SparseOptimizer:
         if mesh is not None:
             math = _replicated_shard_map(math, mesh)
         cache, new_count = math(cache, uids, g, valid, urows, uslot, step,
-                                count, sr_key)
+                                count, sr_key, uqs)
         if self.kind == "adam":
             return cache, (slots[0], slots[1], new_count)
         return cache, slots
 
     def _cache_math(self, cache, uids, g, valid, urows, uslot, step, count,
-                    sr_key):
+                    sr_key, uqs=None):
         """Admission + per-kind cached update on cache-sized operands only
         (big-table rows and slot mirrors arrive pre-gathered as
         ``urows``/``uslot``) — the body ``cache_update_unique`` optionally
         wraps in a replicated shard_map."""
         cache = _cache_admit(cache, urows, uslot, uids, valid, self.kind,
-                             step)
+                             step, uqs)
         c = cache["ids"].shape[0]
         cs, _ = cache_route(cache, uids)
         csc = jnp.minimum(cs, c - 1)
-        cur = jnp.take(cache["rows"], csc, axis=0).astype(jnp.float32)
+        int8 = "qs" in cache
+        if int8:
+            cur = dequantize_rows(
+                jnp.take(cache["rows"], csc, axis=0),
+                jnp.take(cache["qs"], csc, axis=0))
+        else:
+            cur = jnp.take(cache["rows"], csc, axis=0).astype(jnp.float32)
         g = g.astype(jnp.float32)
         lr, wd, eps = self.lr, self.weight_decay, self.eps
         new_count = count
         cache = dict(cache)
+
+        def put_rows(new, key):
+            # storage write: the int8 path re-grids the NEW rows through
+            # quantize_rows (write-time requantize — the flush stays a bit
+            # copy) with the same [U, d] block shape and key the plain
+            # path's _requantize_scatter uses, so codes match bit-for-bit
+            if int8:
+                data, nqs = quantize_rows(new, key)
+                cache["rows"] = cache["rows"].at[cs].set(data, mode="drop")
+                cache["qs"] = cache["qs"].at[cs].set(nqs, mode="drop")
+            else:
+                cache["rows"] = cache["rows"].at[cs].set(
+                    quantize(new, cache["rows"].dtype, key), mode="drop")
+
         if self.kind == "sgd":
             g2 = g + wd * cur
-            cache["rows"] = cache["rows"].at[cs].set(
-                quantize(cur - lr * g2, cache["rows"].dtype, sr_key),
-                mode="drop")
+            put_rows(cur - lr * g2, sr_key)
         elif self.kind == "adagrad":
             acc_r = jnp.take(cache["acc"], csc, axis=0).astype(jnp.float32)
             g2 = g + wd * cur
             acc_n = acc_r + g2 * g2
             delta = lr * g2 / (jnp.sqrt(acc_n) + eps)
-            cache["rows"] = cache["rows"].at[cs].set(
-                quantize(cur - delta, cache["rows"].dtype,
-                         component_key(sr_key, 0)), mode="drop")
+            put_rows(cur - delta, component_key(sr_key, 0))
             cache["acc"] = cache["acc"].at[cs].set(
                 quantize(acc_n, cache["acc"].dtype,
                          component_key(sr_key, 1)), mode="drop")
@@ -1177,9 +1329,7 @@ class SparseOptimizer:
             g2 = g + wd * cur
             acc_n = acc_r + jnp.mean(g2 * g2, axis=-1)
             delta = lr * g2 / (jnp.sqrt(acc_n)[:, None] + eps)
-            cache["rows"] = cache["rows"].at[cs].set(
-                quantize(cur - delta, cache["rows"].dtype,
-                         component_key(sr_key, 0)), mode="drop")
+            put_rows(cur - delta, component_key(sr_key, 0))
             cache["acc"] = cache["acc"].at[cs].set(acc_n, mode="drop")
         elif self.kind == "adam":
             mu_r = jnp.take(cache["mu"], csc, axis=0).astype(jnp.float32)
@@ -1191,9 +1341,7 @@ class SparseOptimizer:
             mu_hat = mu_n / (1 - self.b1**t)
             nu_hat = nu_n / (1 - self.b2**t)
             delta = lr * (mu_hat / (jnp.sqrt(nu_hat) + eps) + wd * cur)
-            cache["rows"] = cache["rows"].at[cs].set(
-                quantize(cur - delta, cache["rows"].dtype,
-                         component_key(sr_key, 0)), mode="drop")
+            put_rows(cur - delta, component_key(sr_key, 0))
             cache["mu"] = cache["mu"].at[cs].set(
                 quantize(mu_n, cache["mu"].dtype, component_key(sr_key, 1)),
                 mode="drop")
@@ -1210,7 +1358,7 @@ class SparseOptimizer:
     def cache_update(self, cache, table, slots, ids, grads, *, step,
                      capacity: int | None = None,
                      max_distinct: int | None = None, sr_key=None,
-                     mesh=None):
+                     mesh=None, qscale=None):
         """Cached analogue of :meth:`update` for plain 2D tables: the SAME
         ``dedupe_grads`` call (bit-identical summed grads), then
         :meth:`cache_update_unique`.  Returns ``(cache, slots)``."""
@@ -1220,16 +1368,25 @@ class SparseOptimizer:
             max_distinct=max_distinct)
         counters.emit("unique_rows", lambda: valid.sum())
         return self.cache_update_unique(cache, table, slots, uids, g, valid,
-                                        step=step, sr_key=sr_key, mesh=mesh)
+                                        step=step, sr_key=sr_key, mesh=mesh,
+                                        qscale=qscale)
 
-    def cache_flush(self, cache, table, slots):
+    def cache_flush(self, cache, table, slots, qscale=None):
         """Write every dirty cached row (+ slot mirrors) back to the big
         table in ONE coalesced scatter — a verbatim bit-copy, so the
         flushed table equals the eager-path table exactly — then evict down
         to the hottest ``C // 2`` entries by (frequency, recency, id) and
         age the retained frequency counters.  Returns ``(cache, table,
         slots, overflow)`` where ``overflow`` is the interval's admission
-        overflow count (MUST be zero; updates past capacity were lost)."""
+        overflow count (MUST be zero; updates past capacity were lost).
+
+        int8 tables pass (and get back) their ``qscale`` sidecar — the
+        return becomes ``(cache, table, slots, qscale, overflow)``.  The
+        flush stays a BIT-COPY (codes + one extra (scale, offset) scatter):
+        requantization already happened per-row at write time in
+        :meth:`cache_update_unique`, which keeps a kill/resume inside a
+        flush interval trivially exact — no flush-time stochastic draw
+        exists to replay."""
         c = cache["ids"].shape[0]
         cids, cslot = cache["ids"], cache["slot"]
         oob = jnp.asarray(_CACHE_OOB, jnp.int32)
@@ -1239,6 +1396,9 @@ class SparseOptimizer:
         tgt = jnp.where(dirty_dir, cids, table.shape[0])
         table = table.at[tgt].set(
             jnp.take(cache["rows"], cslot, axis=0), mode="drop")
+        if qscale is not None:
+            qscale = qscale.at[tgt].set(
+                jnp.take(cache["qs"], cslot, axis=0), mode="drop")
         new_slots = list(slots)
         for key in _cache_mirror_keys(self.kind):
             big = ({"acc": 0, "mu": 0, "nu": 1}[key]
@@ -1269,6 +1429,8 @@ class SparseOptimizer:
         cache["last"] = jnp.where(retained, cache["last"], 0)
         over = cache["over"]
         cache["over"] = jnp.zeros((), jnp.int32)
+        if qscale is not None:
+            return cache, table, tuple(new_slots), qscale, over
         return cache, table, tuple(new_slots), over
 
     def update(self, table, slots, ids, grads, *, embedding_dim: int | None = None,
@@ -1276,7 +1438,10 @@ class SparseOptimizer:
                sr_key=None, qscale=None):
         if table.ndim == 3:
             if qscale is not None:
-                raise ValueError("int8 tables do not ride fat-line storage")
+                raise ValueError(
+                    "fat-line int8 tables carry their (scale, offset) "
+                    "sidecar in-line — qscale is only for plain 2D int8 "
+                    "tables")
             if embedding_dim is None:
                 raise ValueError("fat-table update needs embedding_dim")
             return fat_update(

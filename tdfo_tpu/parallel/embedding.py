@@ -299,10 +299,11 @@ class ShardedEmbeddingCollection:
                     f"sharding, not {s.sharding!r}"
                 )
             if s.fused and jnp.dtype(s.dtype) not in (
-                    jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+                    jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+                    jnp.dtype(jnp.int8)):
                 raise ValueError(
                     f"table {s.name!r}: fused storage supports float32/"
-                    f"bfloat16, not {jnp.dtype(s.dtype).name}")
+                    f"bfloat16/int8, not {jnp.dtype(s.dtype).name}")
             if (s.fused and jnp.dtype(s.dtype) == jnp.bfloat16
                     and fused_kind == "rowwise_adagrad"):
                 # fat lines pack table AND state at one dtype; the rowwise
@@ -312,19 +313,19 @@ class ShardedEmbeddingCollection:
                     f"table {s.name!r}: fused rowwise_adagrad storage "
                     "cannot be bfloat16 (the per-row accumulator is f32 by "
                     "the fbgemm parity contract)")
+            if (s.fused and _spec_is_int8(s)
+                    and fused_kind == "rowwise_adagrad"):
+                # mirror line_layout's refusal with the table name attached
+                raise ValueError(
+                    f"table {s.name!r}: fused int8 storage does not support "
+                    "rowwise_adagrad (the f32 per-row accumulator contract "
+                    "cannot ride a quantized line)")
             if _spec_is_int8(s) and s.sharding == "column":
                 # the (scale, offset) pair is per FULL row; a column shard
                 # would requantize partial rows against a whole-row grid
                 raise ValueError(
                     f"table {s.name!r}: int8 storage supports row/"
                     "replicated/table sharding, not 'column'")
-            if _spec_is_int8(s) and cache_rows > 0:
-                # the cache mirrors rows at storage dtype but flushes by bit
-                # copy WITHOUT the sidecar; config.py refuses the combination
-                # too — this is the construction-time belt-and-braces
-                raise ValueError(
-                    f"table {s.name!r}: int8 storage does not compose with "
-                    "the update cache (cache_rows > 0)")
             for f in s.feature_names():
                 if f in self._feature_to_table:
                     raise ValueError(f"feature {f!r} served by two tables")
@@ -366,7 +367,7 @@ class ShardedEmbeddingCollection:
                 total = sum(s.num_embeddings for s in group)
                 # fused stacks additionally round to whole LINES so shard
                 # boundaries never split a packed line
-                unit = self.fat_layout(dim).r if fused else 1
+                unit = self.fat_layout(dim, group[0].dtype).r if fused else 1
                 if shard_kind == "row":
                     unit *= self.n_shards
                 total = _round_up(total, unit)
@@ -442,13 +443,10 @@ class ShardedEmbeddingCollection:
                     f"table {tname!r}: hot/cold supports plain (non-fused) "
                     f"row/replicated tables; got fused={spec.fused}, "
                     f"sharding={spec.sharding!r}")
-            if _spec_is_int8(spec):
-                # the scatter-free one-hot head update is a full-block
-                # requantize — not an identity on the int8 grid the way the
-                # bf16 bit trick is (ops/quant.py)
-                raise ValueError(
-                    f"table {tname!r}: hot/cold does not compose with int8 "
-                    "storage")
+            # int8 composes: only the COLD residual stores int8 (row-sparse
+            # scatter updates); the hot head is always a small f32 array, so
+            # the scatter-free one-hot full-block requantize never touches
+            # an int8 grid
             if tname in self.hot_ids:
                 raise ValueError(f"table {tname!r} given two hot-id sets")
             if self.hot_array_name(tname) in self.specs:
@@ -522,15 +520,18 @@ class ShardedEmbeddingCollection:
 
     # ---------------------------------------------------------------- init
 
-    def fat_layout(self, d: int):
+    def fat_layout(self, d: int, dtype="float32"):
         """Packed-line geometry of fused storage at embedding dim ``d``
-        under this collection's ``fused_kind``."""
+        under this collection's ``fused_kind``.  ``dtype`` selects the
+        f32-lane layout (default) or the int8 byte-container layout (codes
+        + in-line (scale, offset) sidecar + f32-byte optimizer state)."""
         from tdfo_tpu.ops.pallas_kernels import line_layout
 
-        return line_layout(d, self.fused_kind)
+        return line_layout(d, self.fused_kind, dtype)
 
     def fat_layout_for(self, array_name: str):
-        return self.fat_layout(self.array_embedding_dim(array_name))
+        return self.fat_layout(self.array_embedding_dim(array_name),
+                               self._array_rep_spec(array_name).dtype)
 
     def table_sharding(self, spec: EmbeddingSpec) -> NamedSharding | None:
         if self.mesh is None:
@@ -562,7 +563,8 @@ class ShardedEmbeddingCollection:
             if spec.sharding == "table" or name in fat_members:
                 continue
             rows = spec.num_embeddings
-            unit = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
+            unit = (self.fat_layout(spec.embedding_dim, spec.dtype).r
+                    if spec.fused else 1)
             if spec.sharding == "row":
                 unit *= self.n_shards
             rows = _round_up(rows, unit)
@@ -584,10 +586,13 @@ class ShardedEmbeddingCollection:
             if spec.fused:
                 from tdfo_tpu.ops.pallas_kernels import fat_pack
 
-                # [lines, T, 128]: optimizer state starts at zero
-                t = fat_pack(t, kind=self.fused_kind)
+                # [lines, T, 128]: optimizer state starts at zero.  int8
+                # packs round-to-nearest onto the same rowwise grid as the
+                # plain-int8 draw below, with the (scale, offset) sidecar
+                # IN-LINE — no separate __qscale__/ array.
+                t = fat_pack(t, kind=self.fused_kind, dtype=spec.dtype)
             sh = self.table_sharding(spec)
-            if _spec_is_int8(spec):
+            if _spec_is_int8(spec) and not spec.fused:
                 t, qs = quantize_rows(t)
                 qsh = (None if self.mesh is None else NamedSharding(
                     self.mesh,
@@ -614,8 +619,10 @@ class ShardedEmbeddingCollection:
             return t
 
         def place_stack(gname, arr, group, spec_p):
-            # spec_p None => replicated; quantize int8 stacks AFTER assembly
-            if jnp.dtype(arr.dtype) == jnp.float32 and any(
+            # spec_p None => replicated; quantize int8 stacks AFTER assembly.
+            # Only plain 2D stacks get the separate sidecar — a fused int8
+            # stack arrives already byte-packed (sidecar in-line).
+            if arr.ndim == 2 and jnp.dtype(arr.dtype) == jnp.float32 and any(
                     _spec_is_int8(s) for s in group):
                 arr, qs = quantize_rows(arr)
                 if self.mesh is not None:
@@ -636,7 +643,9 @@ class ShardedEmbeddingCollection:
                 from tdfo_tpu.ops.pallas_kernels import fat_pack
 
                 t = assemble_stack(group, next(key_iter), group[0].dtype)
-                arr = fat_pack(t, kind=self.fused_kind)  # [lines, T, 128]
+                # [lines, T, 128]; int8 quantizes inside fat_pack (RTN, the
+                # plain-int8 init grid) with the sidecar packed in-line
+                arr = fat_pack(t, kind=self.fused_kind, dtype=group[0].dtype)
             else:  # plain 2D table stack (stack_tables=True)
                 arr = assemble_stack(group, next(key_iter), group[0].dtype)
             trailing = (None,) * (arr.ndim - 1)
@@ -650,8 +659,20 @@ class ShardedEmbeddingCollection:
         # become dead storage (never gathered, never updated).
         for tname in sorted(self.hot_ids):
             aname, spec, off = self.resolve_table(tname)
-            hot = jnp.take(
-                tables[aname], jnp.asarray(self.hot_ids[tname]) + off, axis=0)
+            idx = jnp.asarray(self.hot_ids[tname]) + off
+            src = tables[aname]
+            if src.ndim == 3:  # fused cold residual: row gather off the lines
+                from tdfo_tpu.ops.pallas_kernels import fat_gather_rows
+
+                hot = fat_gather_rows(src, idx, self.fat_layout_for(aname))
+            else:
+                hot = jnp.take(src, idx, axis=0)
+                if self.array_is_int8(aname):
+                    # head stays f32: decode the gathered rows on the parent
+                    # grid so the initial effective table is bit-identical
+                    # to the non-split int8 run
+                    hot = dequantize_rows(
+                        hot, jnp.take(tables[qscale_name(aname)], idx, axis=0))
             if self.mesh is not None:
                 hot = jax.device_put(hot, NamedSharding(self.mesh, P()))
             tables[self.hot_array_name(tname)] = hot
@@ -680,7 +701,11 @@ class ShardedEmbeddingCollection:
             t = tables[aname]
             if t.ndim != 2 or aname in hot_heads:
                 continue
-            if opt.kind == "adam" and t.shape[0] <= opt.small_vocab_threshold:
+            if (opt.kind == "adam" and t.shape[0] <= opt.small_vocab_threshold
+                    and not self.array_is_int8(aname)):
+                # the scatter-free dense_lazy_adam tier covers f32/bf16 only;
+                # int8 small-vocab adam arrays stay row-sparse, so the cache
+                # DOES cover them
                 continue
             out.append(aname)
         return tuple(out)
@@ -794,10 +819,11 @@ class ShardedEmbeddingCollection:
             return opt.update(table, slots, ids, grads, embedding_dim=d,
                               capacity=max_distinct, max_distinct=max_distinct,
                               sr_key=sr_key, qscale=qscale)
-        if qscale is not None:  # fused fat storage is f32/bf16-only
+        if qscale is not None:
             raise ValueError(
-                f"array {array_name!r}: int8 tables do not ride the fused "
-                "shard_map update path")
+                f"array {array_name!r}: fat-line int8 tables carry their "
+                "(scale, offset) sidecar in-line — qscale is only for plain "
+                "2D int8 tables")
 
         from tdfo_tpu.core.mesh import DATA_AXIS
         from tdfo_tpu.ops.sparse import fat_update
@@ -806,7 +832,8 @@ class ShardedEmbeddingCollection:
         kind = self.fused_kind
         # table.shape[0] counts LINES; shards own whole lines (init rounds
         # rows to n_shards x R), so each shard covers lines x R vocab rows
-        rows_per_shard = (table.shape[0] // self.n_shards) * self.fat_layout(d).r
+        rows_per_shard = (table.shape[0] // self.n_shards
+                          ) * self.fat_layout(d, table.dtype).r
         ids_flat = ids.reshape(-1)
         grads_flat = grads.reshape(-1, grads.shape[-1])
 
@@ -1027,7 +1054,7 @@ class ShardedEmbeddingCollection:
 
                     vecs = fat_gather_rows(
                         table, ids + offset,
-                        self.fat_layout(spec.embedding_dim),
+                        self.fat_layout(spec.embedding_dim, spec.dtype),
                     )
                 else:
                     vecs = jnp.take(table, ids + offset, axis=0)
@@ -1048,8 +1075,10 @@ class ShardedEmbeddingCollection:
                         f"lookup mode {mode!r} requires row/table sharding, "
                         f"but table {spec.name!r} is {spec.sharding!r}"
                     )
-                qs = (tables[qscale_name(tname)] if _spec_is_int8(spec)
-                      else None)
+                # fused int8 decodes inside the line gather (sidecar rides
+                # in-line), so only plain 2D int8 ships a qscale operand
+                qs = (tables[qscale_name(tname)]
+                      if _spec_is_int8(spec) and not spec.fused else None)
                 if mode == "psum":
                     vecs = self._lookup_psum(table, ids + offset, spec, qs)
                 else:
@@ -1079,10 +1108,22 @@ class ShardedEmbeddingCollection:
         if self._hot_full[tname]:
             return hot_vec  # padding ids clamp to hot row 0 (clip parity)
         aname, spec, offset = self.resolve(feat)
-        cold_vec = jnp.take(
-            tables[aname], jnp.where(cold_ids >= 0, cold_ids + offset, 0),
-            axis=0)
-        return jnp.where((hot_pos >= 0)[..., None], hot_vec, cold_vec)
+        cidx = jnp.where(cold_ids >= 0, cold_ids + offset, 0)
+        src = tables[aname]
+        if src.ndim == 3:  # fused cold residual (incl. int8 byte lines)
+            from tdfo_tpu.ops.pallas_kernels import fat_gather_rows
+
+            cold_vec = fat_gather_rows(src, cidx, self.fat_layout_for(aname))
+        else:
+            cold_vec = jnp.take(src, cidx, axis=0)
+            if _spec_is_int8(spec):
+                # int8 cold residual: decode the SMALL gathered block (the
+                # head is f32, so the select below mixes f32 both sides)
+                cold_vec = dequantize_rows(
+                    cold_vec, jnp.take(tables[qscale_name(aname)], cidx,
+                                       axis=0))
+        return jnp.where((hot_pos >= 0)[..., None],
+                         hot_vec.astype(cold_vec.dtype), cold_vec)
 
     def _local_gather(self, spec: EmbeddingSpec):
         """(table_shard, vocab-row idx) -> [.., d] gather for the explicit
@@ -1093,12 +1134,13 @@ class ShardedEmbeddingCollection:
             return lambda shard, idx: jnp.take(shard, idx, axis=0)
         from tdfo_tpu.ops.pallas_kernels import fat_gather_rows
 
-        lay = self.fat_layout(spec.embedding_dim)
+        lay = self.fat_layout(spec.embedding_dim, spec.dtype)
         return lambda shard, idx: fat_gather_rows(shard, idx, lay)
 
     def _rows_per_shard(self, table: jax.Array, spec: EmbeddingSpec) -> int:
         """Vocab rows per model-axis shard (fat shards count lines x R)."""
-        mult = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
+        mult = (self.fat_layout(spec.embedding_dim, spec.dtype).r
+                if spec.fused else 1)
         return (table.shape[0] // self.n_shards) * mult
 
     # ------------------------------------------------- grouped alltoall
@@ -1115,7 +1157,8 @@ class ShardedEmbeddingCollection:
             group = self._groups[array_name]
             return self._stack_rows[group[0].name][1]
         spec = self.specs[array_name]
-        unit = self.fat_layout(spec.embedding_dim).r if spec.fused else 1
+        unit = (self.fat_layout(spec.embedding_dim, spec.dtype).r
+                if spec.fused else 1)
         if spec.sharding == "row":
             unit *= self.n_shards
         return _round_up(spec.num_embeddings, unit)
@@ -1283,15 +1326,20 @@ class ShardedEmbeddingCollection:
             recv, slot_inv = ctx[g.key]
             shards = tuple(tables[a] for a in g.arrays)
             # groups are dtype-uniform ((dim, dtype) keys), so one flag
-            # covers every member array
+            # covers every member array.  Only plain 2D int8 arrays carry a
+            # separate sidecar — fused int8 lines decode inside the line
+            # gather, so they take no qscale operand.
             is_int8 = jnp.dtype(g.specs[0].dtype) == jnp.int8
-            qshards = (tuple(tables[qscale_name(a)] for a in g.arrays)
-                       if is_int8 else ())
+            qs_arrays = tuple(a for a, s in zip(g.arrays, g.specs)
+                              if is_int8 and not s.fused)
+            qshards = tuple(tables[qscale_name(a)] for a in qs_arrays)
+            qs_pos = {a: i for i, a in enumerate(qs_arrays)}
             gathers = tuple(self._local_gather(s) for s in g.specs)
             local_sizes = tuple(features[f].size // m for f in g.feats)
 
             def complete(recv_l, slot_inv_l, *ops, _g=g,
-                         _gathers=gathers, _sizes=local_sizes):
+                         _gathers=gathers, _sizes=local_sizes,
+                         _qs_pos=qs_pos):
                 shards_l = ops[:len(_g.arrays)]
                 qs_l = ops[len(_g.arrays):]
                 flatr = recv_l.reshape(-1)  # [m * cap]
@@ -1310,9 +1358,19 @@ class ShardedEmbeddingCollection:
                     rows = jnp.where(mine[:, None], rows, 0)
                     vec = rows if vec is None else vec + rows
                     if qs_l:
-                        qrows = jnp.where(
-                            mine[:, None],
-                            jnp.take(qs_l[ai], clipped, axis=0), 0)
+                        qi = _qs_pos.get(_g.arrays[ai])
+                        if qi is None:
+                            # fused int8 member of a mixed group: its rows
+                            # arrive DECODED, so its slots decode again on
+                            # the identity grid (scale 1, offset 0)
+                            qrows = jnp.where(
+                                mine[:, None],
+                                jnp.array([1.0, 0.0], jnp.float32)[None, :],
+                                0.0)
+                        else:
+                            qrows = jnp.where(
+                                mine[:, None],
+                                jnp.take(qs_l[qi], clipped, axis=0), 0)
                         qvec = qrows if qvec is None else qvec + qrows
                 back = jax.lax.all_to_all(
                     vec.reshape(m, -1, vec.shape[-1]), axis,
@@ -1407,8 +1465,12 @@ class ShardedEmbeddingCollection:
             tabs = tuple(tables[a] for a in g.arrays)
             slot_in = tuple(slots[a] for a in g.arrays)
             is_int8 = jnp.dtype(g.specs[0].dtype) == jnp.int8
-            qs_in = (tuple(tables[qscale_name(a)] for a in g.arrays)
-                     if is_int8 else ())
+            # plain 2D int8 arrays carry a separate (scale, offset) sidecar;
+            # fused int8 lines pack it in-line and take no qscale operand
+            qs_arrays = tuple(a for a, s in zip(g.arrays, g.specs)
+                              if is_int8 and not s.fused)
+            qs_in = tuple(tables[qscale_name(a)] for a in qs_arrays)
+            qs_pos = {a: i for i, a in enumerate(qs_arrays)}
             n_local = sum(f.shape[0] for f in flats) // m
             cap = _a2a_bucket_cap(n_local, m, cf)
             stream = m * cap
@@ -1416,12 +1478,17 @@ class ShardedEmbeddingCollection:
             # lines) than it owns, +1 for the dedupe sentinel slot
             mds = []
             for spec, rps in zip(g.specs, g.rows_per_shard):
-                unit = self.fat_layout(g.dim).r if spec.fused else 1
+                # int8 fat lines dedupe in ROW space (per-row requantize),
+                # so their distinct bound counts rows, not lines
+                unit = (self.fat_layout(g.dim, spec.dtype).r
+                        if spec.fused
+                        and jnp.dtype(spec.dtype) != jnp.int8 else 1)
                 mds.append(min(stream, ceil8(rps // unit + 1)))
             mds = tuple(mds)
 
             def local_upd(tabs_l, slots_l, qs_tl, *parts, _g=g,
-                          _feat_rps=feat_rps, _mds=mds, _cap=cap):
+                          _feat_rps=feat_rps, _mds=mds, _cap=cap,
+                          _qs_pos=qs_pos):
                 k = len(_g.feats)
                 key_l = parts[2 * k] if len(parts) > 2 * k else None
                 g_parts = parts[k:2 * k]
@@ -1470,11 +1537,12 @@ class ShardedEmbeddingCollection:
                         uids, gu, valid = dedupe_grads(
                             mids, mg, capacity=md, vocab=rps,
                             max_distinct=md)
-                        if qs_tl:
+                        qi = _qs_pos.get(aname)
+                        if qi is not None:
                             nt, ns, nq = opt.update_unique(
                                 shard, sl, uids, gu, valid,
                                 embedding_dim=_g.dim, sr_key=sk,
-                                qscale=qs_tl[ai])
+                                qscale=qs_tl[qi])
                             out_q.append(nq)
                         else:
                             nt, ns = opt.update_unique(
@@ -1501,7 +1569,7 @@ class ShardedEmbeddingCollection:
             for a, nt, ns in zip(g.arrays, upd_t, upd_s):
                 new_tables[a] = nt
                 new_slots[a] = ns
-            for a, nq in zip(g.arrays, upd_q):
+            for a, nq in zip(qs_arrays, upd_q):
                 # updated sidecars ride new_tables under their prefixed key,
                 # so the train step's dict merge covers them with no extra
                 # call-site plumbing

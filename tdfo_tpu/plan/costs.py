@@ -37,6 +37,9 @@ __all__ = [
     "EXPAND_NS_PER_ID",
     "SEGSUM_NS_PER_TARGET",
     "SCATTER_NS_PER_SLOT_PER_BUFFER",
+    "CACHE_SCATTER_NS_PER_SLOT_PER_BUFFER",
+    "CACHE_ROUTE_NS_PER_ID",
+    "RESHAPE_MS_PER_GB",
     "LINE_GATHER_BASE_NS",
     "LINE_DMA_BASE_NS_PER_DIR",
     "A2A_US_PER_TABLE",
@@ -48,6 +51,7 @@ __all__ = [
     "dense_step_ms",
     "padded_lane_width",
     "table_hbm_bytes",
+    "cache_hbm_bytes",
     "estimate_step_ms",
 ]
 
@@ -85,6 +89,30 @@ SEGSUM_NS_PER_TARGET = 39.0
 # for 102k rows x 2 buffers under rowwise-adagrad).  54 * 2 buffers
 # lands the measured 11 ms at the Criteo profile.
 SCATTER_NS_PER_SLOT_PER_BUFFER = 54.0
+
+# update-cache scatters target the small [C, d] cache arrays (MBs, not
+# GBs) — BUDGET.md's cache_zipf section brackets them 0.05-0.5 ms for ~3k
+# rows x 2 buffers (8-80 ns/slot/buffer, the open question being whether
+# a cache-resident target beats the multi-GB floor).  27 = half the
+# big-table floor is the bracket's middle; the planner only reaches for
+# it on int8 plans, where the eager path's extra sidecar buffer and
+# requantize RMW shift the break-even structurally (module docstring of
+# plan/planner.py records the stance).
+CACHE_SCATTER_NS_PER_SLOT_PER_BUFFER = 27.0
+
+# cache directory route: `searchsorted method="sort"` of the deduped ids
+# into the [C] sorted directory + the admission pair-sorts (BUDGET.md
+# cache_zipf "directory route" + "admission" rows: ~0.15-0.3 ms for 8k
+# ids into 131k).
+CACHE_ROUTE_NS_PER_ID = 25.0
+
+# a trailing-dim retiling reshape MATERIALIZES the array on TPU:
+# [L, 1, 128] -> [L*4, 32] of a 4.3 GB table measured ~10 ms/step
+# (CLAUDE.md).  The int8 fat update goes through exactly that [L*R, W]
+# byte view (ops/sparse._fat_apply_rows_int8) and pays it twice (view +
+# write-back), so big fused-int8 tables carry a bytes-proportional term
+# no descriptor count captures.
+RESHAPE_MS_PER_GB = 2.3
 
 # fat-line forward gather, IN SITU: ~10 ms for 77k x 512 B lines
 # (BUDGET.md fused ablation "forward line gather + slot select" — the
@@ -152,7 +180,12 @@ class TableLoad:
     ``unique_lines`` is the observed fat-line touch count when telemetry
     recorded one; ``None`` falls back to the occupancy estimate
     (:func:`expected_lines`).  ``hot_mass`` is the lookup-mass fraction a
-    ``hot_k``-row hot head absorbs (stats head-mass curve)."""
+    ``hot_k``-row hot head absorbs (stats head-mass curve).
+    ``flush_unique_rows`` is E[distinct rows touched across one
+    ``cache_flush_every``-step interval] (``plan/stats.unique_rows_over``)
+    — only read when the estimator prices the update cache; ``None``
+    falls back to the no-reuse pessimum (``unique_rows`` per step, i.e.
+    the cache never wins)."""
 
     name: str
     vocab: int
@@ -165,6 +198,7 @@ class TableLoad:
     dtype: str = "float32"
     hot_k: int = 0
     hot_mass: float = 0.0
+    flush_unique_rows: float | None = None
 
 
 def in_situ_multiplier(total_unique_rows: float) -> float:
@@ -189,7 +223,26 @@ def line_geometry(dim: int, optimizer: str, dtype: str) -> tuple[int, int]:
     ``dim * (1 + full_slots)`` elements (+1 for the rowwise accumulator),
     padded to a power of two; rows pack into 128-lane f32 lines (256
     elements for bf16 — half the bytes per element, same 512 B line).
+
+    ``dtype == "int8"`` is the BYTE-container line (elements are bytes):
+    ``dim`` code bytes + 8 sidecar bytes (bitcast f32 scale, offset) + 4
+    bytes per f32 state lane, padded to the next slot width from
+    (8, 16, 32, 64, 128) or up to whole 128-byte tiles.  rowwise_adagrad
+    is refused here exactly as ``ops/pallas_kernels.line_layout`` refuses
+    it: its shared scalar accumulator has no per-row byte-container home.
     """
+    if dtype == "int8":
+        if optimizer == "rowwise_adagrad":
+            raise ValueError(
+                "fused int8 storage does not support rowwise_adagrad: the "
+                "rowwise accumulator is a shared scalar per row with no "
+                "byte-container slot in the fat line — keep the table on "
+                "plain int8 storage (optionally cache-fronted) or switch "
+                "the optimizer")
+        need = dim + 8 + 4 * dim * FULL_SLOT_BUFFERS[optimizer]
+        width = next((s for s in (8, 16, 32, 64, 128) if s >= need),
+                     128 * math.ceil(need / 128))
+        return width, max(1, 128 // width)
     elems = dim * (1 + FULL_SLOT_BUFFERS[optimizer])
     if optimizer == "rowwise_adagrad":
         elems += 1
@@ -259,13 +312,17 @@ def table_hbm_bytes(
     the slot buffers at ``slot_dtype`` — so at NARROW dims the ratio vs
     f32 is bounded well under 4x (d=16 sgd: 64 B -> 16 + 8 = 24 B, 2.67x),
     while lane-padded dims approach it (d=64 sgd: 512 B -> 128 + 8 = 136 B,
-    3.76x; the int8 codes lane-pad 128-wide exactly like f32)."""
+    3.76x; the int8 codes lane-pad 128-wide exactly like f32).  Fused int8
+    packs codes + sidecar + f32-byte state into the byte-container line
+    (``line_geometry``), so slot-width padding can make it LARGER than
+    plain int8 at some (dim, optimizer) — the planner prices both."""
     dsize = _DTYPE_BYTES[dtype]
     if fused:
-        if dtype == "int8":
-            raise ValueError("int8 tables do not ride fused fat-line storage")
+        # int8 fat lines are byte containers: the (scale, offset) sidecar
+        # and the f32-byte optimizer state ride IN-LINE, so the line
+        # geometry already prices them (no separate sidecar/slot terms)
         width, rows_per_line = line_geometry(dim, optimizer, dtype)
-        lane_elems = 128 if dtype == "float32" else 256
+        lane_elems = 256 if dtype == "bfloat16" else 128
         if rows_per_line > 1:
             body = math.ceil(vocab / rows_per_line) * lane_elems * dsize
         else:
@@ -287,6 +344,33 @@ def table_hbm_bytes(
     return int(body)
 
 
+def cache_hbm_bytes(
+    dim: int,
+    *,
+    optimizer: str,
+    dtype: str = "float32",
+    cache_rows: int,
+) -> int:
+    """Replicated per-device bytes of ONE update cache
+    (``ops/sparse.cache_init``): ``cache_rows`` rows at the table dtype,
+    the f32 slot mirrors, the int8 (scale, offset) mirror, the rowwise
+    accumulator cell, plus ~16 B/row of int32 directory bookkeeping
+    (sorted ids + permutation + age/dirty).  Stacked arrays share a cache,
+    so the planner charges one per plain storage GROUP."""
+    c = int(cache_rows)
+    if c <= 0:
+        return 0
+    padded = padded_lane_width(dim)
+    row = padded * _DTYPE_BYTES[dtype]
+    row += FULL_SLOT_BUFFERS[optimizer] * padded * 4
+    if optimizer == "rowwise_adagrad":
+        row += 4
+    if dtype == "int8":
+        row += 8
+    row += 16
+    return c * row
+
+
 # --------------------------------------------------------------------------
 # step-cost estimator
 # --------------------------------------------------------------------------
@@ -299,6 +383,7 @@ def estimate_step_ms(
     dense_model: str,
     batch_size: int,
     n_devices: int = 1,
+    cache_flush_every: int | None = None,
 ) -> dict:
     """Predicted per-device train-step milliseconds for a set of placed
     tables, assuming the measured-fastest formulation of each path:
@@ -309,7 +394,22 @@ def estimate_step_ms(
         (the 22.4 ms Criteo formulation);
       * fused tables stack into fat-line arrays per (dim, dtype,
         sharding) — dedupe, line gather, segment-sum, in-place DMA kernel
-        (the 1.40 ms TwoTower formulation);
+        (the 1.40 ms TwoTower formulation).  Fused INT8 arrays update in
+        ROW space instead (``ops/sparse._fat_apply_rows_int8``: byte-row
+        gather + one packed scatter through the ``[L*R, W]`` view), so
+        they pay row-gather + single-buffer-scatter descriptor costs plus
+        the view's retiling materialization (``RESHAPE_MS_PER_GB``);
+      * plain int8 tables pay one EXTRA scatter buffer (the f32
+        (scale, offset) sidecar written alongside the requantized codes);
+      * ``cache_flush_every`` (when not ``None``) prices every plain
+        group as cache-fronted (``[embeddings] cache_rows``): per-step
+        scatters move to the cache-resident arrays
+        (``CACHE_SCATTER_NS_PER_SLOT_PER_BUFFER``), the deduped ids pay
+        the directory route, and the big-table write-back (admission
+        gather + coalesced flush scatter of the interval's
+        ``flush_unique_rows``) amortizes over the interval.  Fused groups
+        ignore it (the cache covers plain 2D arrays only —
+        ``parallel/embedding.cached_array_names``);
       * a ``hot_k`` head removes ``hot_mass`` of the table's traffic from
         the scattered path and pays one one-hot MXU update per table
         (heads are per-table and serialize — BUDGET.md hot/cold table).
@@ -322,12 +422,19 @@ def estimate_step_ms(
     """
     if optimizer not in SCATTER_BUFFERS:
         raise ValueError(f"unknown sparse optimizer {optimizer!r}")
+    f_every = int(cache_flush_every) if cache_flush_every else 0
     cold: list[dict] = []
     hot_ms = 0.0
     per_table = {ld.name: 0.0 for ld in loads}
     for ld in loads:
         ids, uniq = float(ld.ids_per_batch), float(ld.unique_rows)
         lines = ld.unique_lines
+        # interval working set for the cache write-back; absent stats fall
+        # back to the no-reuse pessimum (flush == uniq per step amortized,
+        # so the cache never looks like a win without an occupancy curve)
+        flush = ld.flush_unique_rows
+        if flush is None and f_every:
+            flush = min(float(ld.vocab), uniq * f_every)
         if ld.hot_k > 0:
             k = min(ld.hot_k, ld.vocab)
             mass = 1.0 if ld.hot_k >= ld.vocab else min(1.0, max(0.0, ld.hot_mass))
@@ -337,7 +444,9 @@ def estimate_step_ms(
             ids *= 1.0 - mass
             uniq *= 1.0 - mass
             lines = None if lines is None else lines * (1.0 - mass)
-        cold.append(dict(load=ld, ids=ids, uniq=uniq, lines=lines))
+            flush = None if flush is None else flush * (1.0 - mass)
+        cold.append(dict(load=ld, ids=ids, uniq=uniq, lines=lines,
+                         flush=flush))
 
     # the in-situ ramp keys on the step's total per-device touched rows
     def _div(ld: TableLoad) -> float:
@@ -365,24 +474,74 @@ def estimate_step_ms(
                 c["lines"] if c["lines"] is not None else expected_lines(
                     c["uniq"], c["load"].vocab, rpl)
                 for c in members) / div
-            group_ms = (
-                ids * DEDUPE_NS_PER_ID
-                + lines * LINE_GATHER_BASE_NS * m
-                + uniq * SEGSUM_NS_PER_TARGET
-                + lines * 2 * LINE_DMA_BASE_NS_PER_DIR * m
-            ) / 1e6
+            if dtype == "int8":
+                # row-space int8 fat update (no DMA kernel): forward line
+                # gather stays, the update pays byte-row gather + ONE
+                # packed-row scatter through the [L*R, W] view — which
+                # retiles, so the whole fat array materializes twice per
+                # step (free only when the view is a unit-dim collapse,
+                # i.e. one 128-byte-slot row per line)
+                table_gb = sum(
+                    table_hbm_bytes(c["load"].vocab, dim,
+                                    optimizer=optimizer, dtype=dtype,
+                                    fused=True)
+                    for c in members) / div / float(1 << 30)
+                reshape_ms = (0.0 if (rpl == 1 and width == 128)
+                              else 2.0 * RESHAPE_MS_PER_GB * table_gb)
+                group_ms = (
+                    ids * DEDUPE_NS_PER_ID
+                    + lines * LINE_GATHER_BASE_NS * m
+                    + uniq * SEGSUM_NS_PER_TARGET
+                    + uniq * ROW_GATHER_BASE_NS * m
+                    + uniq * SCATTER_NS_PER_SLOT_PER_BUFFER
+                ) / 1e6 + reshape_ms
+            else:
+                group_ms = (
+                    ids * DEDUPE_NS_PER_ID
+                    + lines * LINE_GATHER_BASE_NS * m
+                    + uniq * SEGSUM_NS_PER_TARGET
+                    + lines * 2 * LINE_DMA_BASE_NS_PER_DIR * m
+                ) / 1e6
         else:
-            group_ms = (
+            # plain int8 scatters the f32 (scale, offset) sidecar alongside
+            # the requantized codes: one extra buffer
+            buffers = SCATTER_BUFFERS[optimizer] + (1 if dtype == "int8"
+                                                    else 0)
+            common = (
                 ids * DEDUPE_NS_PER_ID
                 + uniq * ROW_GATHER_BASE_NS * m
                 + ids * EXPAND_NS_PER_ID
                 + uniq * SEGSUM_NS_PER_TARGET
-                # NO in-situ ramp on the scatter: the ~54 ns/slot floor IS
-                # the at-scale in-situ figure (BUDGET.md measured the 102k-
-                # row scatter in the full step; small-scale XLA scatters
-                # are ~170 ns/row, i.e. scatters do not get WORSE at scale)
-                + uniq * SCATTER_NS_PER_SLOT_PER_BUFFER * SCATTER_BUFFERS[optimizer]
-            ) / 1e6
+            )
+            if f_every:
+                # cache-fronted: per-step scatters hit the small cache
+                # arrays (incl. the int8 qs mirror — the per-step
+                # requantize keeps bit-parity with the eager path), the
+                # deduped ids pay the directory route, and the big-table
+                # write-back (admission row gather + coalesced flush of
+                # the interval's distinct rows) amortizes over the
+                # interval
+                flush_rows = sum(
+                    min(c["flush"], float(c["load"].vocab))
+                    for c in members) / div / float(f_every)
+                group_ms = (
+                    common
+                    + uniq * CACHE_ROUTE_NS_PER_ID
+                    + uniq * CACHE_SCATTER_NS_PER_SLOT_PER_BUFFER * buffers
+                    + flush_rows * (ROW_GATHER_BASE_NS * m
+                                    + SCATTER_NS_PER_SLOT_PER_BUFFER
+                                    * buffers)
+                ) / 1e6
+            else:
+                group_ms = (
+                    common
+                    # NO in-situ ramp on the scatter: the ~54 ns/slot floor
+                    # IS the at-scale in-situ figure (BUDGET.md measured the
+                    # 102k-row scatter in the full step; small-scale XLA
+                    # scatters are ~170 ns/row, i.e. scatters do not get
+                    # WORSE at scale)
+                    + uniq * SCATTER_NS_PER_SLOT_PER_BUFFER * buffers
+                ) / 1e6
         sparse_ms += group_ms
         if sharding in ("row", "table") and n_devices > 1:
             a2a_ms += len(members) * A2A_US_PER_TABLE / 1000.0

@@ -20,17 +20,28 @@ deterministic order, re-pricing the whole step for each candidate, until
 a sweep changes nothing.  Tables are few (dozens) and the estimator is
 O(tables), so this is milliseconds of host work.
 
-Deliberately conservative stances (both provenanced in docs/BUDGET.md):
+Deliberately conservative stances (all provenanced in docs/BUDGET.md):
 
   * bf16 storage is priced step-time-NEUTRAL — the fat-line bf16 ablation
     was never chip-measured (tunnel outage; BUDGET.md quantized-storage
     section records the expected ~1.7x as UNMEASURED), so dtype is chosen
     only as an HBM lever (it halves allocated bytes — that part IS
     measured) during budget demotion, never on predicted speed.
-  * the update cache is priced at the pessimistic end of BUDGET.md's
-    cache_zipf expectation (break-even-to-loss at flush_every=1), so the
-    planner always emits ``cache_rows: 0`` — an operator can still turn
-    the cache on by hand after measuring their own profile.
+  * the update cache is considered ONLY for plans that carry plain int8
+    storage.  For f32/bf16 the stance stays at the pessimistic end of
+    BUDGET.md's cache_zipf expectation (break-even-to-loss: the cache
+    moves scatters, it does not remove them), so pure-float plans keep
+    emitting ``cache_rows: 0`` and an operator opts in by hand after
+    measuring.  Plain int8 shifts the break-even structurally — the
+    eager path pays an EXTRA sidecar scatter buffer plus a per-step
+    requantize read-modify-write on the multi-GB table — so the
+    post-pass prices the cache-fronted step at the bracket middle
+    (``costs.CACHE_SCATTER_NS_PER_SLOT_PER_BUFFER``) with the honest
+    flush cost (the interval working set from the stats occupancy
+    curve), and emits ``cache_rows > 0`` IFF the model predicts a win
+    AND the caches fit the HBM budget.  On no-reuse (uniform) traffic
+    the working set equals ``flush_every x uniq`` and the cache correctly
+    never wins; it takes Zipf-style reuse to tip it.
 """
 
 from __future__ import annotations
@@ -44,6 +55,7 @@ from typing import Mapping
 
 from tdfo_tpu.plan.costs import (
     TableLoad,
+    cache_hbm_bytes,
     estimate_step_ms,
     table_hbm_bytes,
 )
@@ -55,12 +67,14 @@ from tdfo_tpu.plan.stats import (
     table_stats_digest,
     unique_lines_at,
     unique_rows_at,
+    unique_rows_over,
 )
 
 __all__ = [
     "FORMAT_VERSION",
     "PLAN_FILENAME",
     "FUSED_MIN_VOCAB",
+    "CACHE_FLUSH_EVERY",
     "plan_tables",
     "write_plan",
     "load_plan",
@@ -78,6 +92,11 @@ PLAN_FILENAME = "sharding_plan.json"
 # config default ``fused_table_threshold`` (small tables ride the one-hot
 # MXU tier / plain stacks; fat packing them was never measured).
 FUSED_MIN_VOCAB = 16384
+
+# Flush cadence a cache-carrying plan prices and emits — the
+# ``[embeddings] flush_every`` config default, so a plan-driven cache
+# behaves exactly like the hand-set knob it replaces.
+CACHE_FLUSH_EVERY = 64
 
 _SHARDINGS = ("row", "replicated", "table")
 _DTYPES = ("float32", "bfloat16", "int8")
@@ -111,31 +130,27 @@ def _candidates(name: str, entry: dict, optimizer: str,
                           or sharding not in ("row", "replicated")):
                 continue
             for dtype in _DTYPES:
-                if fused and dtype == "bfloat16" \
+                if fused and dtype != "float32" \
                         and optimizer == "rowwise_adagrad":
                     # the fat line packs the accumulator at the table
-                    # dtype; EXACT_ROWWISE_ADAGRAD requires f32 accum
-                    # (refused at collection construction, PR 5)
-                    continue
-                if fused and dtype == "int8":
-                    # fat lines carry no per-row (scale, offset) sidecar
-                    # (refused at collection construction)
+                    # dtype (bf16, PR 5) or cannot carry it at all (int8:
+                    # the f32 per-row accumulator contract cannot ride a
+                    # quantized line); EXACT_ROWWISE_ADAGRAD requires f32
+                    # accum (refused at collection construction)
                     continue
                 for hot_k in hot_ks:
                     if hot_k > 0 and (
                             fused or sharding not in ("row", "replicated")):
                         # hot heads require a plain, row/replicated base
-                        # table (parallel/embedding.py hot_ids contract)
-                        continue
-                    if hot_k > 0 and dtype == "int8":
-                        # the hot head's scatter-free update is a full-block
-                        # requantize — illegal on the int8 grid
+                        # table (parallel/embedding.py hot_ids contract);
+                        # int8 composes — the head stays f32, only the
+                        # cold residual stores codes
                         continue
                     out.append(_Candidate(sharding, fused, dtype, hot_k))
     return out
 
 
-def _loads(names, stats, decisions, *, dim, batch_size):
+def _loads(names, stats, decisions, *, dim, batch_size, flush_steps=None):
     loads = []
     for name in names:
         entry = stats[name]
@@ -153,6 +168,9 @@ def _loads(names, stats, decisions, *, dim, batch_size):
             dtype=d.dtype,
             hot_k=d.hot_k,
             hot_mass=head_mass_at(entry, d.hot_k),
+            flush_unique_rows=(
+                unique_rows_over(entry, batch_size, flush_steps)
+                if flush_steps else None),
         ))
     return loads
 
@@ -208,11 +226,14 @@ def plan_tables(
     cands = {n: _candidates(n, stats[n], optimizer, n_devices)
              for n in names}
 
-    def total_ms(decisions):
+    def total_ms(decisions, cache=False):
+        flush = CACHE_FLUSH_EVERY if cache else None
         return estimate_step_ms(
-            _loads(names, stats, decisions, dim=dim, batch_size=batch_size),
+            _loads(names, stats, decisions, dim=dim, batch_size=batch_size,
+                   flush_steps=flush),
             optimizer=optimizer, dense_model=dense_model,
-            batch_size=batch_size, n_devices=n_devices)
+            batch_size=batch_size, n_devices=n_devices,
+            cache_flush_every=flush)
 
     # start at the config-default placement: row-sharded plain f32 —
     # candidate 0 by construction
@@ -283,7 +304,44 @@ def plan_tables(
         else:
             raise ValueError("planner HBM repair did not converge")
 
-    final = total_ms(decisions)
+    # update-cache post-pass (module docstring): only a plan carrying
+    # plain int8 storage considers the cache — its eager path pays the
+    # sidecar scatter buffer + per-step requantize on the big table, which
+    # is what the cache-fronted pricing can beat on reuse-heavy traffic
+    use_cache, cache_rows, cache_bytes = False, 0, 0
+    if any(d.dtype == "int8" and not d.fused for d in decisions.values()):
+        # size the cache to the biggest plain storage GROUP's interval
+        # working set (stacked arrays share one cache; directories are
+        # replicated, so no device division), next power of two with 2x
+        # slack so retention never overflows mid-interval
+        group_ws: dict[tuple, float] = {}
+        for name in names:
+            d = decisions[name]
+            if d.fused:
+                continue
+            ws = unique_rows_over(stats[name], batch_size,
+                                  CACHE_FLUSH_EVERY)
+            if d.hot_k > 0:
+                ws *= 1.0 - head_mass_at(stats[name], d.hot_k)
+            key = (d.dtype, d.sharding)
+            group_ws[key] = group_ws.get(key, 0.0) + ws
+        c = 1024
+        while c < 2.0 * max(group_ws.values()) and c < (1 << 21):
+            c *= 2
+        c_bytes = sum(
+            cache_hbm_bytes(dim, optimizer=optimizer, dtype=dt,
+                            cache_rows=c)
+            for dt, _sh in sorted(group_ws))
+        t_loads, _ = _device_loads(
+            names, stats, decisions, dim=dim, optimizer=optimizer,
+            slot_dtype=slot_dtype, n_devices=n_devices)
+        fits = budget <= 0 or max(t_loads) + c_bytes <= budget
+        cached_ms = total_ms(decisions, cache=True)["total_ms"]
+        if fits and cached_ms < best - 1e-9:
+            use_cache, cache_rows, cache_bytes = True, c, c_bytes
+            best = cached_ms
+
+    final = total_ms(decisions, cache=use_cache)
     loads, assignment = _device_loads(
         names, stats, decisions, dim=dim, optimizer=optimizer,
         slot_dtype=slot_dtype, n_devices=n_devices)
@@ -329,13 +387,16 @@ def plan_tables(
         "dense_model": dense_model,
         "hbm_gb": float(hbm_gb),
         "slot_dtype": slot_dtype,
-        # measured-pessimistic stances (module docstring): never planned on
-        "cache_rows": 0,
+        # update-cache decision (module docstring): > 0 only when a plain
+        # int8 plan predicts a cache win that fits the budget; f32/bf16
+        # plans keep the measured-pessimistic 0 (operator opt-in)
+        "cache_rows": int(cache_rows),
+        "cache_flush_every": CACHE_FLUSH_EVERY if use_cache else 0,
         "stats_digest": table_stats_digest(stats),
         "predicted_step_ms": round(final["total_ms"], 6),
         "predicted_default_ms": round(default_ms, 6),
         "predicted_dense_ms": round(final["dense_ms"], 6),
-        "max_device_hbm_bytes": max(loads),
+        "max_device_hbm_bytes": max(loads) + cache_bytes,
         "default_max_device_hbm_bytes": max(default_loads),
         "tables": tables,
     }
@@ -456,6 +517,12 @@ def format_plan(plan: dict) -> str:
         lines.append(
             f"per-device HBM: plan {cur:.1f} MB vs all-defaults "
             f"{dflt:.1f} MB ({dflt - cur:+.1f} MB saved)"
+        )
+    if plan.get("cache_rows"):
+        lines.append(
+            f"update cache: cache_rows {plan['cache_rows']} @ flush_every "
+            f"{plan['cache_flush_every']} (int8 write-combining; cache HBM "
+            "counted in the per-device total)"
         )
     return "\n".join(lines)
 

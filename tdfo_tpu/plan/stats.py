@@ -43,6 +43,7 @@ __all__ = [
     "load_table_stats",
     "table_stats_digest",
     "unique_rows_at",
+    "unique_rows_over",
     "unique_lines_at",
     "head_mass_at",
     "head_ids_for",
@@ -57,8 +58,12 @@ _FILENAME = "table_stats.json"
 
 # per-batch unique-row estimates are precomputed at these batch sizes; the
 # planner interpolates between them (linear in B — the curve is smooth and
-# concave, interpolation error is far below the cost model's tolerance)
-BATCH_GRID = (1024, 2048, 4096, 8192, 16384, 32768)
+# concave, interpolation error is far below the cost model's tolerance).
+# The flush-scale tail points (>= 131072) price the update cache's
+# per-interval working set (``unique_rows_over`` at flush_every x B
+# draws); artifacts written before they existed clamp at 32768.
+BATCH_GRID = (1024, 2048, 4096, 8192, 16384, 32768,
+              131072, 524288, 2097152)
 
 # head-mass curve sample points (the planner's hot-split candidate sizes)
 HEAD_K_GRID = (1024, 4096, 8192, 16384)
@@ -216,6 +221,22 @@ def unique_rows_at(entry: dict, batch_size: int) -> float:
         return float(obs["unique_rows"])
     u = _interp_grid(entry["unique_per_batch"], float(batch_size))
     return min(u, float(entry["vocab"]), float(batch_size))
+
+
+def unique_rows_over(entry: dict, batch_size: int, steps: int) -> float:
+    """Expected DISTINCT rows touched across ``steps`` consecutive
+    batches — the update cache's per-flush-interval working set (what the
+    coalesced write-back scatters and what ``cache_rows`` must hold).
+    Reads the same occupancy curve as :func:`unique_rows_at`, at
+    ``steps * batch_size`` draws.  Artifacts written before the
+    flush-scale grid points existed clamp at their largest sample — an
+    UNDERestimate of the working set (optimistic toward the cache);
+    regenerate ``table_stats.json`` for honest flush pricing.  Never
+    returns less than the single-batch estimate."""
+    n = float(int(steps) * int(batch_size))
+    u = _interp_grid(entry["unique_per_batch"], n)
+    u = min(u, float(entry["vocab"]), n)
+    return max(u, unique_rows_at(entry, batch_size))
 
 
 def unique_lines_at(entry: dict, batch_size: int) -> float | None:

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import optax
 
 from tdfo_tpu.obs import counters as obs_counters
-from tdfo_tpu.ops.quant import dequantize_rows
+from tdfo_tpu.ops.quant import bytes_to_f32, dequantize_rows
 from tdfo_tpu.ops.quant import sr_key as _make_sr_key
 from tdfo_tpu.ops.sparse import SparseOptimizer, cache_lookup_rows, dedupe_ids
 from tdfo_tpu.ops.sparse import cache_overlay_rows
@@ -380,11 +380,17 @@ def make_sparse_train_step(
                     flat = lines.reshape(cap_l, lay.tiles * 128)
                     rowlines = jnp.take(
                         flat, jnp.minimum(row_lidx, cap_l - 1), axis=0)
-                    rows = rowlines[:, :d]
+                    # int8 byte lines slot-select codes AND the adjacent 8
+                    # sidecar bytes, then decode the small selected block
+                    span = d + 8 if lay.dtype == "int8" else d
+                    rows = rowlines[:, :span]
                     for s in range(1, lay.r):
                         rows = jnp.where(
                             (row_slot == s)[:, None],
-                            rowlines[:, s * lay.w: s * lay.w + d], rows)
+                            rowlines[:, s * lay.w: s * lay.w + span], rows)
+                    if lay.dtype == "int8":
+                        rows = dequantize_rows(
+                            rows[:, :d], bytes_to_f32(rows[:, d:span]))
                     dedup_ctx[tname] = ("routed", ulines, seg, row_lidx,
                                         row_slot, lines)
                     obs_counters.emit(f"emb/{tname}/unique_lines",
@@ -523,6 +529,8 @@ def make_sparse_train_step(
                     ck = CACHE_PREFIX + tname
                     u_r, g_r, v_r = _pin_replicated(
                         coll.mesh, (uids, g_u, valid))
+                    qsc = (state.tables[qscale_name(tname)]
+                           if coll.array_is_int8(tname) else None)
                     with obs_counters.scope(f"emb/{tname}/"):
                         new_cache, new_slots[tname] = (
                             state.sparse_opt.cache_update_unique(
@@ -530,11 +538,15 @@ def make_sparse_train_step(
                                 state.tables[tname],
                                 state.slots[tname], u_r, g_r, v_r,
                                 step=state.step, sr_key=_sr_key(tname),
-                                mesh=coll.mesh,
+                                mesh=coll.mesh, qscale=qsc,
                             ))
                     new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
                     continue
-                if coll.array_is_int8(tname):
+                if (coll.array_is_int8(tname)
+                        and state.tables[tname].ndim == 2):
+                    # plain 2D int8: the (scale, offset) sidecar is a
+                    # separate array; fat int8 carries it in-line and
+                    # never threads qscale
                     qn = qscale_name(tname)
                     (new_tables[tname], new_slots[tname],
                      new_tables[qn]) = state.sparse_opt.update_unique(
@@ -566,6 +578,8 @@ def make_sparse_train_step(
                 ck = CACHE_PREFIX + tname
                 i_r, g_r = _pin_replicated(
                     coll.mesh, (all_ids, all_grads))
+                qsc = (state.tables[qscale_name(tname)]
+                       if coll.array_is_int8(tname) else None)
                 with obs_counters.scope(f"emb/{tname}/"):
                     new_cache, new_slots[tname] = (
                         state.sparse_opt.cache_update(
@@ -574,12 +588,16 @@ def make_sparse_train_step(
                             state.slots[tname], i_r, g_r,
                             step=state.step, capacity=md, max_distinct=md,
                             sr_key=_sr_key(tname), mesh=coll.mesh,
+                            qscale=qsc,
                         ))
                 new_slots[ck] = _pin_replicated(coll.mesh, new_cache)
                 continue
             # sharding-aware routing: fused row-sharded tables update inside
             # an explicit shard_map (Pallas has no GSPMD partition rule)
-            if coll.array_is_int8(tname):
+            if (coll.array_is_int8(tname)
+                    and state.tables[tname].ndim == 2):
+                # plain 2D int8 threads the separate qscale sidecar; fat
+                # int8 byte containers carry it in-line
                 qn = qscale_name(tname)
                 (new_tables[tname], new_slots[tname],
                  new_tables[qn]) = coll.sparse_update(
@@ -665,10 +683,21 @@ def make_cache_flush_fn(*, donate: bool = True, jit: bool = True,
             if not key.startswith(CACHE_PREFIX):
                 continue
             aname = key[len(CACHE_PREFIX):]
+            qn = qscale_name(aname)
             with obs_counters.scope(f"emb/{aname}/"):
-                cache, table, slots, over = state.sparse_opt.cache_flush(
-                    _pin_replicated(mesh, state.slots[key]),
-                    state.tables[aname], state.slots[aname])
+                if qn in state.tables:
+                    # int8 array: flush bit-copies codes AND the per-row
+                    # (scale, offset) grid back into the table + sidecar
+                    cache, table, slots, qsc, over = (
+                        state.sparse_opt.cache_flush(
+                            _pin_replicated(mesh, state.slots[key]),
+                            state.tables[aname], state.slots[aname],
+                            qscale=state.tables[qn]))
+                    new_tables[qn] = qsc
+                else:
+                    cache, table, slots, over = state.sparse_opt.cache_flush(
+                        _pin_replicated(mesh, state.slots[key]),
+                        state.tables[aname], state.slots[aname])
             new_tables[aname] = table
             new_slots[aname] = slots
             new_slots[key] = _pin_replicated(mesh, cache)
@@ -842,7 +871,10 @@ def make_pipelined_sparse_train_step(
             all_grads = jnp.concatenate([
                 g_embs[f].reshape(-1, g_embs[f].shape[-1]) for f in feats])
             md = -(-bound // 8) * 8 if bound < all_ids.shape[0] else None
-            if coll.array_is_int8(tname):
+            if (coll.array_is_int8(tname)
+                    and state.tables[tname].ndim == 2):
+                # plain 2D int8 threads the separate qscale sidecar; fat
+                # int8 byte containers carry it in-line
                 qn = qscale_name(tname)
                 (new_tables[tname], new_slots[tname],
                  new_tables[qn]) = coll.sparse_update(

@@ -639,6 +639,10 @@ class Trainer:
         ]
         plan = None
         hot_ids = None
+        # the plan owns the update-cache decision when present (config
+        # validation refuses hand-set cache_rows alongside a plan)
+        cache_rows_eff = cfg.embeddings.cache_rows
+        flush_every_eff = cfg.embeddings.flush_every
         if cfg.planner.plan:
             from tdfo_tpu.plan.planner import apply_plan_to_specs, load_plan
 
@@ -649,6 +653,18 @@ class Trainer:
             # the plan is the single owner of the per-table levers)
             plan = load_plan(cfg.planner.plan)
             specs, hot_ids = apply_plan_to_specs(specs, plan)
+            cache_rows_eff = int(plan.get("cache_rows", 0) or 0)
+            if cache_rows_eff > 0:
+                flush_every_eff = int(plan.get("cache_flush_every") or
+                                      cfg.embeddings.flush_every)
+                # the config-time cache gates only see embeddings.cache_rows;
+                # a plan-carried cache must honor the same contracts
+                if cfg.steps_per_execution != 1 or cfg.train.pipeline_overlap:
+                    raise ValueError(
+                        "the sharding plan enables the update cache "
+                        f"(cache_rows = {cache_rows_eff}), which requires "
+                        "steps_per_execution = 1 and train.pipeline_overlap "
+                        "= false — adjust the config or re-plan")
         if cfg.embeddings.hot_vocab > 0:
             from tdfo_tpu.data.hot_ids import load_hot_ids
 
@@ -672,7 +688,7 @@ class Trainer:
             fused_kind=cfg.sparse_optimizer,
             hot_ids=hot_ids,
             grouped_a2a=cfg.embeddings.grouped_a2a,
-            cache_rows=cfg.embeddings.cache_rows,
+            cache_rows=cache_rows_eff,
         )
         # hot/cold checkpoints are only loadable under the SAME hot sets —
         # stamp the digests into the checkpoint sidecar so a mismatched
@@ -696,15 +712,26 @@ class Trainer:
             from tdfo_tpu.ops.quant import QSCALE_LAYOUT
 
             stamps["qscale_layout"] = QSCALE_LAYOUT
-        if cfg.embeddings.cache_rows > 0:
+            # fused int8 arrays pack the sidecar IN-LINE (byte-container fat
+            # lines, no __qscale__/ entry): stamp per-array storage so a
+            # legacy int8-unfused checkpoint refuses to restore into an
+            # int8-fused run and vice versa.  Unfused int8 runs add no key,
+            # keeping their sidecars byte-identical to pre-fused-int8 ones.
+            fat_inline = {
+                s.name: "fat-inline" for s in specs
+                if jnp.dtype(s.dtype) == jnp.int8 and s.fused}
+            if fat_inline:
+                stamps["qscale_storage"] = fat_inline
+        if cache_rows_eff > 0:
             # the cache arrays live in state.slots: a cached checkpoint
             # cannot restore into a cache-off run (or vice versa, or across
             # cache_rows), so stamp both knobs — flush_every too, so the
-            # restored run's flush cadence matches what the operator asked
-            # for rather than silently inheriting the sidecar-less default
+            # restored run's flush cadence matches what the operator (or
+            # the plan) asked for rather than silently inheriting the
+            # sidecar-less default
             stamps["update_cache"] = {
-                "cache_rows": int(cfg.embeddings.cache_rows),
-                "flush_every": int(cfg.embeddings.flush_every),
+                "cache_rows": int(cache_rows_eff),
+                "flush_every": int(flush_every_eff),
             }
         if plan is not None:
             from tdfo_tpu.plan.planner import plan_digest
@@ -745,7 +772,7 @@ class Trainer:
                 slot_dtype=cfg.embeddings.slot_dtype,
             ),
         ), self.mesh)
-        if cfg.embeddings.cache_rows > 0:
+        if cache_rows_eff > 0:
             # device-resident update cache: empty caches ride state.slots
             # (kill/resume, NaN-rollback snapshots and donation all cover
             # them for free); the coalesced write-back runs as a SEPARATE
@@ -760,7 +787,7 @@ class Trainer:
                     self.state, slots={**self.state.slots, **caches})
                 self._cache_flush = make_cache_flush_fn(
                     mesh=coll.mesh, counters=self._counters_on)
-                self._flush_every = cfg.embeddings.flush_every
+                self._flush_every = flush_every_eff
         if cfg.train.pipeline_overlap:
             # TrainPipelineSparseDist parity: batch N+1's input-dist issues
             # inside the jitted step ahead of batch N's fwd/bwd/update.  The

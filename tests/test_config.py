@@ -1,6 +1,9 @@
 import json
 from pathlib import Path
 
+import jax
+import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from tdfo_tpu.core.config import Config, MeshSpec, read_configs
@@ -386,6 +389,47 @@ def test_embeddings_dtype_validation():
     # table bf16 with f32 slots is the rowwise-compatible combination
     Config(model="dlrm", sparse_optimizer="rowwise_adagrad",
            embeddings=EmbeddingsSpec(table_dtype="bfloat16"))
+
+
+def test_int8_composition_matrix():
+    """PR 18 makes storage dtype and layout orthogonal: int8 composes with
+    the update cache, hot/cold, and the fused fat line.  The retained
+    refusals (int8 slots, fused-int8 x rowwise_adagrad, int8 x column
+    sharding) keep actionable errors."""
+    from tdfo_tpu.core.config import EmbeddingsSpec
+
+    # lifted: int8 x update cache (rows admitted dequantized, requantized
+    # per row at write time, codes + sidecar scattered at flush)
+    Config(model="dlrm", lookup_mode="gspmd",
+           embeddings=EmbeddingsSpec(table_dtype="int8", cache_rows=4096))
+    # lifted: int8 x hot/cold (the one-hot MXU update only ever touches
+    # the f32 hot HEAD; the cold residual stays row-sparse int8)
+    Config(model="dlrm", lookup_mode="gspmd",
+           embeddings=EmbeddingsSpec(table_dtype="int8", hot_vocab=1024))
+    # lifted: all three knobs at once
+    Config(model="dlrm", lookup_mode="gspmd",
+           embeddings=EmbeddingsSpec(table_dtype="int8", hot_vocab=1024,
+                                     cache_rows=4096))
+    # retained: rowwise_adagrad's shared f32 accumulator cannot ride a
+    # quantized fat line — refused unless fusing is disabled outright,
+    # and the message names the escape hatches
+    with pytest.raises(ValueError, match="fused_table_threshold = -1"):
+        Config(model="dlrm", sparse_optimizer="rowwise_adagrad",
+               embeddings=EmbeddingsSpec(table_dtype="int8"))
+    Config(model="dlrm", sparse_optimizer="rowwise_adagrad",
+           fused_table_threshold=-1,
+           embeddings=EmbeddingsSpec(table_dtype="int8"))
+    # retained: int8 x column sharding (a column shard has no whole rows
+    # to requantize against the per-ROW sidecar) — collection-level
+    from tdfo_tpu.parallel.embedding import (
+        EmbeddingSpec, ShardedEmbeddingCollection)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("model",))
+    with pytest.raises(ValueError, match="column"):
+        ShardedEmbeddingCollection(
+            [EmbeddingSpec("t", 256, 16, features=("t",), sharding="column",
+                           dtype=jnp.int8)],
+            mesh=mesh)
 
 
 def test_planner_table(tmp_path: Path):

@@ -21,6 +21,7 @@ import pytest
 
 from tdfo_tpu.plan.costs import (
     TableLoad,
+    cache_hbm_bytes,
     estimate_step_ms,
     expected_lines,
     in_situ_multiplier,
@@ -29,6 +30,7 @@ from tdfo_tpu.plan.costs import (
     table_hbm_bytes,
 )
 from tdfo_tpu.plan.planner import (
+    CACHE_FLUSH_EVERY,
     FUSED_MIN_VOCAB,
     apply_plan_to_specs,
     format_plan,
@@ -46,6 +48,7 @@ from tdfo_tpu.plan.stats import (
     table_stats_digest,
     table_stats_from_counts,
     unique_rows_at,
+    unique_rows_over,
     write_table_stats,
 )
 
@@ -349,9 +352,13 @@ def test_planner_hbm_budget_demotes_and_refuses(criteo_stats):
 
 def test_planner_demotes_to_int8_under_tight_budget(criteo_stats):
     """A budget bf16 cannot satisfy pushes big tables onto int8 storage
-    (the 3.76x d=64 / 2.67x d=16 HBM lever), the summary reports the
-    per-device HBM saved vs all-defaults, and int8 entries never ride the
-    composition paths it refuses (fused, hot/cold)."""
+    (the 3.76x d=64 / 2.67x d=16 HBM lever) and the summary reports the
+    per-device HBM saved vs all-defaults.  int8 now composes with the
+    fused and hot/cold layouts, but on THIS profile neither wins: the
+    Criteo optimizer is rowwise_adagrad (fused int8 is a retained
+    refusal — no per-row second moment to byte-pack), and uniform
+    traffic has no head for hot/cold and no reuse for the update cache,
+    so the tight-budget plan stays plain int8 with cache_rows 0."""
     plan = _criteo_plan(criteo_stats, n_devices=8, hbm_gb=0.25)
     int8 = {n: e for n, e in plan["tables"].items()
             if e["dtype"] == "int8"}
@@ -359,10 +366,89 @@ def test_planner_demotes_to_int8_under_tight_budget(criteo_stats):
     assert plan["max_device_hbm_bytes"] <= 0.25 * (1 << 30)
     assert plan["max_device_hbm_bytes"] \
         < plan["default_max_device_hbm_bytes"]
-    for e in int8.values():
-        assert not e["fused"] and e["hot_k"] == 0
+    for n, e in int8.items():
+        # rowwise_adagrad keeps the fused-int8 refusal everywhere
+        assert not e["fused"], n
+        # uniform traffic never justifies a PARTIAL hot head on a big
+        # demoted table; small tables may keep their fully-hot MXU tier
+        # while demoting — that composition is exactly what this PR lifts
+        if e["vocab"] > FUSED_MIN_VOCAB:
+            assert e["hot_k"] == 0, n
+        elif e["hot_k"]:
+            assert e["hot_k"] == e["vocab"], n
+    assert plan["cache_rows"] == 0  # no reuse -> cache cannot win
+    assert plan["cache_flush_every"] == 0
     text = format_plan(plan)
     assert "per-device HBM" in text and "int8" in text
+
+
+@pytest.fixture(scope="module")
+def criteo_zipf_stats():
+    """Zipf(1.2) traffic over the Criteo vocabs: heavy reuse inside a
+    flush interval, the regime the update cache was measured in
+    (docs/BUDGET.md cache_zipf brackets)."""
+    stats = {}
+    for i, v in enumerate(CRITEO_VOCABS):
+        p = np.arange(1, v + 1, dtype=np.float64) ** -1.2
+        counts = np.floor(p / p.sum() * 10_000_000).astype(np.int64)
+        counts[0] += 10_000_000 - counts.sum()
+        stats[f"cat_{i}"] = table_stats_from_counts(counts)
+    return stats
+
+
+def test_planner_zipf_tight_budget_selects_int8_cache(criteo_zipf_stats):
+    """The lifted composition actually gets SELECTED: under the same
+    tight budget but zipf traffic (interval working set << touched rows
+    x flush_every), the plan demotes to int8 AND fronts the plain-int8
+    storage with the update cache, pricing the flush from the stats
+    occupancy curve.  Deterministic and digest-stamped like every plan."""
+    kw = dict(dim=16, batch_size=8192, optimizer="rowwise_adagrad",
+              dense_model="dlrm", n_devices=8, hbm_gb=0.25)
+    plan = plan_tables(criteo_zipf_stats, **kw)
+    int8 = {n: e for n, e in plan["tables"].items()
+            if e["dtype"] == "int8"}
+    assert int8, plan["tables"]
+    # the acceptance composition: at least one int8+fused table or a
+    # cache-fronted int8 plan (rowwise_adagrad refuses fused int8, so
+    # here it must be the cache)
+    assert any(e["fused"] for e in int8.values()) \
+        or plan["cache_rows"] > 0
+    assert plan["cache_rows"] > 0
+    assert plan["cache_flush_every"] == CACHE_FLUSH_EVERY
+    # cache HBM is accounted inside the budget, not snuck past it
+    assert plan["max_device_hbm_bytes"] <= 0.25 * (1 << 30)
+    plan2 = plan_tables(criteo_zipf_stats, **kw)
+    assert plan == plan2
+    assert plan_digest(plan) == plan_digest(plan2)
+    assert plan["stats_digest"] == table_stats_digest(criteo_zipf_stats)
+    text = format_plan(plan)
+    assert "update cache" in text and str(plan["cache_rows"]) in text
+
+
+def test_unique_rows_over_and_cache_hbm():
+    """Interval working set: monotone in steps, clamped by vocab and by
+    total draws, and never below the single-batch unique count.  Cache
+    HBM prices codes + slots + sidecars + directory per plain group."""
+    p = np.arange(1, 100_001, dtype=np.float64) ** -1.2
+    counts = np.floor(p / p.sum() * 1_000_000).astype(np.int64)
+    counts[0] += 1_000_000 - counts.sum()
+    e = table_stats_from_counts(counts)
+    u1 = unique_rows_at(e, 8192)
+    u64 = unique_rows_over(e, 8192, 64)
+    assert u1 <= unique_rows_over(e, 8192, 1) + 1e-6
+    assert u1 < u64 < 64 * u1  # reuse: sublinear growth
+    assert u64 <= e["vocab"]
+    assert unique_rows_over(e, 8192, 10**9) <= e["vocab"]
+    # int8 rowwise cache row: 16 codes + 4 slot + 8 qscale + 16 directory
+    c = cache_hbm_bytes(16, optimizer="rowwise_adagrad", dtype="int8",
+                        cache_rows=1024)
+    assert c == 1024 * (16 + 4 + 8 + 16)
+    f = cache_hbm_bytes(16, optimizer="rowwise_adagrad", dtype="float32",
+                        cache_rows=1024)
+    assert f == 1024 * (16 * 4 + 4 + 16)  # d=16 keeps narrow tiles
+    f64 = cache_hbm_bytes(64, optimizer="adam", dtype="float32",
+                          cache_rows=1024)
+    assert f64 == 1024 * (128 * 4 + 2 * 128 * 4 + 16)  # d=64 lane-pads
 
 
 def test_load_plan_validation(tmp_path, criteo_stats):

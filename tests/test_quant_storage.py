@@ -586,15 +586,38 @@ def test_int8_hbm_geometry_criteo_profile():
     drop vs f32 at the Criteo d=64 profile.  At d=16 the narrow-tile rule
     (<=16 lanes stay unpadded for BOTH dtypes) caps the win at the honest
     byte ratio — pinned >= 2.4x so the docstring's ceiling stays true."""
-    from tdfo_tpu.plan.costs import table_hbm_bytes
+    from tdfo_tpu.plan.costs import line_geometry, table_hbm_bytes
 
     V = 33_762_577  # the Criteo-TB vocab the ROADMAP names
     for dim, floor in ((64, 3.5), (16, 2.4)):
         f32 = table_hbm_bytes(V, dim, optimizer="sgd", dtype="float32")
         i8 = table_hbm_bytes(V, dim, optimizer="sgd", dtype="int8")
         assert f32 / i8 >= floor, (dim, f32 / i8)
-    with pytest.raises(ValueError, match="fused"):
-        table_hbm_bytes(V, 64, optimizer="sgd", dtype="int8", fused=True)
+    # the lifted composition: fused int8 prices a byte-container line of
+    # [codes | 8 B (scale, offset) sidecar | packed f32 slots] per row.
+    # At d=64 the byte packing beats plain int8's f32 slot lane padding
+    # (adam: 640 vs 1160 B/row); at d=16 plain slots already tile narrow
+    # so fusing only rounds rows UP to a power-of-two line — never pick
+    # fused int8 for HBM at d<=16.
+    for opt, width, rpl in (("sgd", 128, 1), ("adagrad", 384, 1),
+                            ("adam", 640, 1)):
+        assert line_geometry(64, opt, "int8") == (width, rpl)
+        fused = table_hbm_bytes(V, 64, optimizer=opt, dtype="int8",
+                                fused=True)
+        plain = table_hbm_bytes(V, 64, optimizer=opt, dtype="int8")
+        assert fused == V * width
+        assert fused < plain, (opt, fused, plain)
+    assert line_geometry(16, "sgd", "int8") == (32, 4)
+    assert table_hbm_bytes(V, 16, optimizer="sgd", dtype="int8",
+                           fused=True) > \
+        table_hbm_bytes(V, 16, optimizer="sgd", dtype="int8")
+    # the one retained geometry refusal: rowwise_adagrad's shared scalar
+    # accumulator has no per-row byte-container home
+    with pytest.raises(ValueError, match="rowwise_adagrad"):
+        line_geometry(64, "rowwise_adagrad", "int8")
+    with pytest.raises(ValueError, match="rowwise_adagrad"):
+        table_hbm_bytes(V, 64, optimizer="rowwise_adagrad", dtype="int8",
+                        fused=True)
 
 
 def test_int8_stamps_refuse_mismatched_restore(tmp_path):
@@ -628,12 +651,30 @@ def test_int8_stamps_refuse_mismatched_restore(tmp_path):
     with pytest.raises(ValueError, match="stamps"):
         mgr2.restore(state, stamps=dict(stamp))
     mgr2.close()
+    # fused int8 packs the sidecar IN-LINE (no __qscale__/ array): the
+    # qscale_storage stamp keys the layout, so a legacy int8-unfused
+    # checkpoint refuses to restore into an int8-fused run and vice versa
+    fused = {**stamp, "qscale_storage": {"t0": "fat-inline"}}
+    mgr3 = CheckpointManager(tmp_path / "q3")
+    mgr3.save(0, state, stamps=fused)
+    assert mgr3.restore(state, stamps=dict(fused))[0] == 0
+    with pytest.raises(ValueError, match="stamps"):
+        mgr3.restore(state, stamps=dict(stamp))     # fused ckpt, unfused run
+    mgr3.close()
+    mgr4 = CheckpointManager(tmp_path / "q4")
+    mgr4.save(0, state, stamps=dict(stamp))
+    with pytest.raises(ValueError, match="stamps"):
+        mgr4.restore(state, stamps=dict(fused))     # unfused ckpt, fused run
+    mgr4.close()
 
 
 def test_trainer_stamps_qscale_layout(tmp_path):
     """The trainer's checkpoint stamps carry qscale_layout exactly when an
     int8 table is configured — f32/bf16 runs keep the stamp absent so their
-    sidecars stay byte-compatible with pre-int8 checkpoints."""
+    sidecars stay byte-compatible with pre-int8 checkpoints.  The newly
+    legal combos stamp COMPOSITIONALLY: fused int8 adds the per-array
+    qscale_storage key (sidecar rides the fat line), cache-fronted int8
+    adds update_cache — qscale_layout alongside both."""
     from tdfo_tpu.core.config import read_configs
     from tdfo_tpu.ops.quant import QSCALE_LAYOUT
     from tdfo_tpu.train.trainer import Trainer
@@ -641,18 +682,34 @@ def test_trainer_stamps_qscale_layout(tmp_path):
     size_map = {"user": 100, "item": 80, "language": 8, "is_ebook": 2,
                 "format": 8, "publisher": 16, "pub_decade": 16}
 
-    def build(**embeddings):
+    def build(embeddings=None, **kw):
         cfg = read_configs(
             None, model="dlrm", data_dir=str(tmp_path), embed_dim=8,
-            size_map=size_map, stack_tables=False, embeddings=embeddings)
+            size_map=size_map, stack_tables=False,
+            embeddings=embeddings or {}, **kw)
         return Trainer(cfg, log_dir=tmp_path)
 
-    t = build(table_dtype="int8", slot_dtype="bfloat16")
+    t = build(dict(table_dtype="int8", slot_dtype="bfloat16"))
     assert t._ckpt_stamps.get("qscale_layout") == QSCALE_LAYOUT
+    assert "qscale_storage" not in t._ckpt_stamps
     assert t.state.tables["user_embed"].dtype == jnp.int8
     assert "__qscale__/user_embed" in t.state.tables
     t2 = build()
     assert "qscale_layout" not in (t2._ckpt_stamps or {})
+    # int8 x fused (threshold 0 fuses every table): the sidecar moves into
+    # the byte-container line, stamped per array so unfused checkpoints
+    # refuse fused runs (and vice versa — see the restore test above)
+    tf = build(dict(table_dtype="int8"), fused_table_threshold=0)
+    assert tf._ckpt_stamps["qscale_layout"] == QSCALE_LAYOUT
+    assert set(tf._ckpt_stamps["qscale_storage"].values()) == {"fat-inline"}
+    fats = [a for a in tf.state.tables.values() if a.ndim == 3]
+    assert fats and all(a.dtype == jnp.int8 for a in fats)  # byte containers
+    assert not any(k.startswith("__qscale__/") for k in tf.state.tables)
+    # int8 x update cache: both stamps ride together
+    tc = build(dict(table_dtype="int8", cache_rows=64),
+               lookup_mode="gspmd")
+    assert tc._ckpt_stamps["qscale_layout"] == QSCALE_LAYOUT
+    assert tc._ckpt_stamps["update_cache"]["cache_rows"] == 64
 
 
 def test_export_dequantizes_int8_exactly(mesh8):

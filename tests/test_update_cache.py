@@ -252,6 +252,88 @@ def test_cache_composes_hot_cold_and_bf16(mesh8, hot, dtype):
             "__hot" not in k for k in cached), cached
 
 
+@pytest.mark.parametrize("kind,flush_every", [
+    # PR 18 lifts the int8 x cache refusal: the cache stores the codes
+    # plus a "qs" (scale, offset) mirror, write-time requantize runs the
+    # SAME quantize_rows call with the SAME sr_key(step, table) as the
+    # eager plain-int8 path, and the flush bit-copies codes + one qs
+    # scatter — so the whole trajectory (codes, sidecars, slots, losses)
+    # is bit-identical to the cache-off plain-int8 run.  One
+    # hit-dominated case + one mid-cadence case tier-1; the remaining
+    # kinds ride the slow tier (each case is 2x5 eager mesh8 steps).
+    ("rowwise_adagrad", 1),
+    pytest.param("adam", 3, marks=pytest.mark.slow),
+    pytest.param("sgd", 3, marks=pytest.mark.slow),
+    pytest.param("adagrad", 8, marks=pytest.mark.slow),
+])
+def test_cache_matches_eager_int8(mesh8, kind, flush_every):
+    """int8 storage x update cache, bit-identical to the plain-int8
+    eager reference for every optimizer kind (the PR 18 acceptance bar —
+    SR keys preserved through the cached write path)."""
+    l0, s0, _ = _baseline(mesh8, kind, True, dtype=jnp.int8)
+    l1, s1, _ = _run(mesh8, kind, True, 1024, flush_every, dtype=jnp.int8)
+    assert l0 == l1
+    _assert_state_bitwise(s0, s1, f"int8/{kind}/fe={flush_every}")
+
+
+def test_cache_int8_kill_resume_mid_flush_interval(mesh8):
+    """Kill/resume MID-interval with dirty int8 rows in the cache: the
+    cache (codes + qs mirror) rides state.slots, so a host round trip +
+    rebuilt step and flush fns replays into the same bits as the
+    uninterrupted cached run — and both match the eager reference.  No
+    flush-time SR exists to desynchronise (requantize happens at write
+    time inside the step).  rowwise_adagrad shares its eager baseline
+    with the parity case above (the module-level _BASELINES cache)."""
+    kind, dedup, fe = "rowwise_adagrad", True, 3
+    l0, s0, _ = _baseline(mesh8, kind, dedup, dtype=jnp.int8)
+
+    coll = ShardedEmbeddingCollection(
+        [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row",
+                       dtype=jnp.int8) for c in CATS],
+        mesh=mesh8, stack_tables=True, cache_rows=1024)
+    bb = DLRMBackbone(embed_dim=8, cat_columns=CATS, cont_columns=CONTS)
+    dummy_e = {c: jnp.zeros((1, 8), jnp.float32) for c in CATS}
+    dummy_c = {c: jnp.zeros((1,), jnp.float32) for c in CONTS}
+    state = SparseTrainState.create(
+        dense_params=bb.init(jax.random.key(1), dummy_e, dummy_c)["params"],
+        tx=optax.adam(1e-2), tables=coll.init(jax.random.key(0)),
+        sparse_opt=sparse_optimizer(kind, lr=1e-2, weight_decay=1e-3,
+                                    small_vocab_threshold=100))
+    caches = coll.init_caches(state.tables, state.sparse_opt)
+    state = dataclasses.replace(state, slots={**state.slots, **caches})
+    flush = make_cache_flush_fn(donate=False, jit=False)
+    step = make_sparse_train_step(coll, ctr_sparse_forward(bb), donate=False,
+                                  dedup_lookup=dedup, jit=False)
+    rr = np.random.default_rng(12)
+    batches = []
+    for _ in range(N_STEPS):
+        b = {c: jnp.asarray(rr.integers(0, SIZES[c], 32), jnp.int32)
+             for c in CATS}
+        b["x0"] = jnp.asarray(rr.random(32, dtype=np.float32))
+        b["label"] = jnp.asarray(rr.integers(0, 2, 32), jnp.float32)
+        batches.append(b)
+
+    losses = []
+    for i, b in enumerate(batches):
+        state, loss = step(state, b)
+        losses.append(
+            np.asarray(loss).astype(np.float32).view(np.uint32).item())
+        if (i + 1) % fe == 0:
+            state, over = flush(state)
+            assert all(int(v) == 0 for v in over.values())
+        if i == 3:  # step 4 of 5: one step past the fe=3 flush — dirty rows
+            state = jax.tree_util.tree_map(
+                lambda x: jnp.asarray(np.asarray(x)), state)
+            step = make_sparse_train_step(
+                coll, ctr_sparse_forward(bb), donate=False,
+                dedup_lookup=dedup, jit=False)
+            flush = make_cache_flush_fn(donate=False, jit=False)
+    state, over = flush(state)
+    assert all(int(v) == 0 for v in over.values())
+    assert losses == l0
+    _assert_state_bitwise(s0, state, "int8 kill/resume mid-interval")
+
+
 @pytest.mark.parametrize("kind", [
     # each case compiles two distinct mesh8 programs — one representative
     # (rowwise: the Criteo default) in tier-1, the rest slow
@@ -337,9 +419,10 @@ def _scatter_operand_dims(closed) -> list[int]:
     return dims
 
 
-def _pin_setup(mesh, cache_rows):
+def _pin_setup(mesh, cache_rows, dtype=jnp.float32):
     coll = ShardedEmbeddingCollection(
-        [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row")
+        [EmbeddingSpec(c, SIZES[c], 8, features=(c,), sharding="row",
+                       dtype=dtype)
          for c in CATS],
         mesh=mesh, stack_tables=True, cache_rows=cache_rows)
     bb = DLRMBackbone(embed_dim=8, cat_columns=CATS, cont_columns=CONTS)
@@ -385,6 +468,28 @@ def test_nonflush_step_has_no_big_table_scatter(mesh8):
     _, estate, estep, _ = _pin_setup(mesh8, 0)
     edims = _scatter_operand_dims(jax.make_jaxpr(estep)(estate, batch))
     assert any(d >= v_big for d in edims)
+
+
+def test_nonflush_step_has_no_big_table_scatter_int8(mesh8):
+    """The acceptance jaxpr pin for the int8 composition: with int8
+    storage + cache, non-flush steps scatter into NEITHER the big code
+    table NOR the big [V, 2] qscale sidecar — requantized codes and
+    grids land in cache space; the flush program carries both coalesced
+    big scatters (codes bit-copy + one qs scatter)."""
+    coll, state, step, batch = _pin_setup(mesh8, 128, dtype=jnp.int8)
+    caches = coll.init_caches(state.tables, state.sparse_opt)
+    state = dataclasses.replace(state, slots={**state.slots, **caches})
+    v_big = min(t.shape[0] for t in state.tables.values())
+    assert v_big >= 357  # stacked codes AND the [V, 2] qscale sidecar
+
+    dims = _scatter_operand_dims(jax.make_jaxpr(step)(state, batch))
+    big = [d for d in dims if d >= v_big]
+    assert not big, f"big-table scatters in the int8 non-flush step: {dims}"
+
+    flush = make_cache_flush_fn(donate=False, jit=False)
+    fdims = _scatter_operand_dims(jax.make_jaxpr(flush)(state))
+    assert sum(d >= v_big for d in fdims) >= 2, \
+        f"int8 flush must scatter codes AND qscale: {fdims}"
 
 
 def test_cache_off_graph_is_byte_identical(mesh8):
