@@ -1283,6 +1283,124 @@ def bench_serving(batch_size: int = 8192, embed_dim: int = 64,
     return out
 
 
+def bench_serve_seq(batch_size: int = 8192, n_items: int = 200_000,
+                    max_len: int = 64, embed_dim: int = 64,
+                    top_k: int = 100) -> dict:
+    """``serve_seq8``: the SEQUENCE serving family's latency twins of
+    ``serve_score8``/``serve_retrieve8`` — masked-position candidate
+    scoring (history window in, appended-MASK logits over the 101-wide
+    eval panel out) and next-item MIPS against the trained item-embedding
+    table reused as the corpus (``serve/seq_scoring.py:item_corpus``).
+    Timed by the same chain differencing as every other record (CLAUDE.md
+    tunnel rules); each scanned batch folds the carry into its history ids
+    so no two scored batches are identical (defeats result caching), and
+    tables ride as chain ARGUMENTS, never closures (compile payload)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tdfo_tpu.core.config import MeshSpec
+    from tdfo_tpu.core.mesh import make_mesh
+    from tdfo_tpu.data.seq_preprocessing import EVAL_NEG_NUM
+    from tdfo_tpu.models.bert4rec import Bert4RecConfig, make_sharded_bert4rec
+    from tdfo_tpu.serve.export import export_bundle, load_bundle
+    from tdfo_tpu.serve.retrieval import make_retrieval
+    from tdfo_tpu.serve.seq_scoring import item_corpus, make_seq_scorer
+
+    mesh = make_mesh(MeshSpec(data=-1, model=1, seq=1))
+    cfg = Bert4RecConfig(n_items=n_items, max_len=max_len,
+                         embed_dim=embed_dim, n_heads=2, n_layers=2)
+    coll, tables, backbone, dense = make_sharded_bert4rec(
+        jax.random.key(0), cfg, mesh, sharding="row", fused_threshold=None)
+    with tempfile.TemporaryDirectory() as td:
+        bundle = load_bundle(export_bundle(
+            td + "/bundle", model="bert4rec", embed_dim=embed_dim,
+            cat_columns=(), cont_columns=(),
+            size_map={"n_items": n_items}, coll=coll, tables=tables,
+            dense_params=dense,
+            seq={"max_len": max_len, "n_heads": cfg.n_heads,
+                 "n_layers": cfg.n_layers}))
+    scorer = make_seq_scorer(bundle, mesh=mesh)
+    n_cands = EVAL_NEG_NUM + 1
+    out: dict[str, object] = {"batch": batch_size, "n_items": n_items,
+                              "max_len": max_len, "n_cands": n_cands,
+                              "embed_dim": embed_dim, "top_k": top_k}
+    s_tables, s_dense = scorer._params
+
+    def _roll(batch, carry):
+        # fresh valid item ids every scanned step; the window keeps its
+        # appended-MASK last position so the scored program is the real one
+        batch = dict(batch)
+        seqs = (batch["seqs"] + carry) % n_items + 1
+        batch["seqs"] = seqs.at[:, -1].set(scorer.mask_id)
+        return batch
+
+    def run_score(k):
+        @jax.jit
+        def chain(tables, dense, stack):
+            def body(carry, batch):
+                logits = scorer._score(_roll(batch, carry), tables, dense)
+                return jnp.abs(logits).sum().astype(jnp.int32) % 128, None
+
+            final, _ = jax.lax.scan(body, jnp.int32(0), stack)
+            return final
+
+        return lambda stack: chain(s_tables, s_dense, stack)
+
+    def _make_host_panels(r, rows):
+        return {
+            "seqs": np.concatenate(
+                [r.integers(1, n_items + 1, size=(rows, max_len - 1)),
+                 np.full((rows, 1), n_items + 1)], axis=1).astype(np.int32),
+            "cands": r.integers(1, n_items + 1,
+                                size=(rows, n_cands)).astype(np.int32),
+        }
+
+    def make_score_args(k, seed):
+        r = np.random.default_rng(seed)
+        host = _make_host_panels(r, batch_size * k)
+        return (_stack_batches(mesh, host, k, batch_size),)
+
+    sec = chain_time(run_score, make_score_args, ks=(16, 128), reps=3)
+    out["serve_seq_score8"] = {
+        "batch_ms": round(sec * 1e3, 3),
+        "rows_per_sec": round(batch_size / sec, 1),
+    }
+
+    # next-item retrieval: the trained item table IS the corpus — queries
+    # are last-position hidden states, here synthesized at the right shape
+    # (query_embed cost is part of the score record above)
+    corpus = item_corpus(bundle, mesh=mesh)
+    retrieve = make_retrieval(corpus, mesh=mesh, top_k=top_k)
+
+    def run_retrieve(k):
+        @jax.jit
+        def chain(vectors, ids, qstack):
+            def body(carry, q):
+                s, _ = retrieve.jitted(q + carry, vectors, ids)
+                return jnp.abs(s).sum() * jnp.float32(1e-9), None
+
+            final, _ = jax.lax.scan(body, jnp.float32(0), qstack)
+            return final
+
+        return lambda qstack: chain(corpus.vectors, corpus.ids, qstack)
+
+    def make_retrieve_args(k, seed):
+        r = np.random.default_rng(seed)
+        q = jax.device_put(
+            r.standard_normal((k, batch_size, embed_dim)).astype(np.float32))
+        float(jnp.sum(q))
+        return (q,)
+
+    sec = chain_time(run_retrieve, make_retrieve_args, ks=(16, 128), reps=3)
+    out["serve_seq_retrieve8"] = {
+        "batch_ms": round(sec * 1e3, 3),
+        "queries_per_sec": round(batch_size / sec, 1),
+    }
+    return out
+
+
 def bench_serve_fleet(replicas: int = 2, embed_dim: int = 16,
                       requests_per_step: int = 128, knee_steps: int = 3,
                       p99_slo_ms: float = 50.0) -> dict:
@@ -1523,6 +1641,10 @@ def main() -> None:
     ap.add_argument("--skip-serving", action="store_true",
                     help="skip the serving-path records (serve_score8 / "
                          "serve_retrieve8)")
+    ap.add_argument("--skip-serve-seq", action="store_true",
+                    help="skip the sequence-serving records (serve_seq8: "
+                         "masked-position scoring + next-item retrieval "
+                         "against the item-table corpus)")
     ap.add_argument("--skip-cache", action="store_true",
                     help="skip the update-cache amortization record "
                          "(cache_zipf)")
@@ -1642,6 +1764,13 @@ def main() -> None:
         except Exception as e:  # serving records must never kill the headline
             print(f"bench: serving bench failed: {e!r}", file=sys.stderr)
 
+    serve_seq = {}
+    if on_tpu and not args.skip_serve_seq and not args.dense:
+        try:
+            serve_seq = bench_serve_seq(args.batch_size)
+        except Exception as e:  # seq records must never kill the headline
+            print(f"bench: serve-seq bench failed: {e!r}", file=sys.stderr)
+
     serve_fleet = {}
     # no on_tpu gate: the fleet record measures the HOST serving stack
     # (replica children are always JAX_PLATFORMS=cpu)
@@ -1738,6 +1867,7 @@ def main() -> None:
         "embedding_lookup_p50_us": lookup,
         "big_table_demo": big_table,
         "serving": serving,
+        "serve_seq8": serve_seq,
         "serve_fleet8": serve_fleet,
         "cache_zipf": cache_zipf,
         "cache_int8_zipf": cache_int8_zipf,

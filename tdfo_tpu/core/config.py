@@ -40,7 +40,28 @@ __all__ = [
     "TrainSpec",
     "read_configs",
     "load_size_map",
+    "serving_model_kind",
 ]
+
+
+def serving_model_kind(config) -> str:
+    """Which serving family ``serve``/``online`` stand up for this config:
+    ``"ctr"`` (twotower/dlrm scalar-logit bundles) or ``"seq"`` (bert4rec
+    masked-position bundles).  ``[serving] model_kind = "auto"`` follows the
+    model; an explicit kind was already cross-checked against the model at
+    config time.  Unknown models refuse LOUDLY here — the serve/online
+    dispatch point — instead of shape-crashing deep in a scorer."""
+    kind = config.serving.model_kind
+    if kind != "auto":
+        return kind
+    if config.model in ("twotower", "dlrm"):
+        return "ctr"
+    if config.model == "bert4rec":
+        return "seq"
+    raise ValueError(
+        f"no serving family for model = {config.model!r}: CTR bundles "
+        "serve twotower/dlrm, seq bundles serve bert4rec — serve/online "
+        "cannot stand up this model")
 
 
 @dataclass(frozen=True)
@@ -279,6 +300,22 @@ class ServingSpec:
     # (no further respawns; the fleet degrades to the survivors and the
     # quarantine is recorded loudly, never silent).
     flap_max_deaths: int = 3
+    # which bundle family `serve`/`online` stand up: "auto" follows the
+    # config's model (twotower/dlrm -> ctr, bert4rec -> seq), "ctr"/"seq"
+    # pin it explicitly and REFUSE a mismatched model at config time — the
+    # loud dispatch error instead of a shape crash deep in the scorer.
+    model_kind: str = "auto"
+    # newest raw-history items the seq frontend keeps when windowing a
+    # ragged user history into the fixed [max_len] eval window (truncate-
+    # left, torchrec/preprocessing.py:229-239).  0 = max_len - 1 (the eval
+    # protocol's full window); smaller values drop older items and left-pad
+    # more.  Must leave room for the appended MASK: <= max_len - 1.
+    max_history: int = 0
+    # row-count bucket set for the SEQ frontend's micro-batcher (sequence
+    # requests carry [n, max_len] history panels, so the right fill
+    # thresholds are smaller than CTR's).  Empty = reuse `buckets`.  The
+    # jit-cache bound is len(history_buckets) programs, same contract.
+    history_buckets: tuple[int, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -940,6 +977,40 @@ class Config:
             raise ValueError(
                 "serving flap_max_deaths must be >= 2: one death must never "
                 "quarantine a replica (every kill drill dies exactly once)")
+        if self.serving.model_kind not in ("auto", "ctr", "seq"):
+            raise ValueError(
+                "serving model_kind must be 'auto', 'ctr' or 'seq', got "
+                f"{self.serving.model_kind!r}")
+        if self.serving.model_kind == "ctr" and self.model == "bert4rec":
+            raise ValueError(
+                "serving model_kind = 'ctr' does not match model = "
+                "'bert4rec': the seq family exports a bert4rec bundle — set "
+                "model_kind to 'seq' (or 'auto')")
+        if (self.serving.model_kind == "seq"
+                and self.model not in ("bert4rec",)):
+            raise ValueError(
+                f"serving model_kind = 'seq' does not match model = "
+                f"{self.model!r}: only bert4rec exports a sequence bundle — "
+                "set model_kind to 'ctr' (or 'auto')")
+        if self.serving.max_history < 0:
+            raise ValueError(
+                "serving max_history must be >= 0 (0 = the full max_len - 1 "
+                "eval window)")
+        if self.serving.max_history > self.max_len - 1:
+            raise ValueError(
+                "serving max_history must leave room for the appended MASK "
+                f"position: <= max_len - 1 = {self.max_len - 1}, got "
+                f"{self.serving.max_history}")
+        if self.serving.history_buckets:
+            if any(b < 1 for b in self.serving.history_buckets):
+                raise ValueError(
+                    "serving history_buckets must be positive batch shapes")
+            if (list(self.serving.history_buckets)
+                    != sorted(set(self.serving.history_buckets))):
+                raise ValueError(
+                    "serving history_buckets must be strictly increasing "
+                    "(each padded shape compiles one program; duplicates/"
+                    "disorder hide that)")
         if self.loadgen.mode not in ("closed", "open"):
             raise ValueError(
                 "loadgen mode must be 'closed' or 'open', got "
@@ -1176,9 +1247,10 @@ def read_configs(config_path: str | os.PathLike | None = None, **overrides: Any)
         if unknown_serving:
             raise ValueError(
                 f"unknown serving config keys: {sorted(unknown_serving)}")
-        if "buckets" in serving_raw:
-            serving_raw = dict(serving_raw,
-                               buckets=tuple(serving_raw["buckets"]))
+        for tup_key in ("buckets", "history_buckets"):
+            if tup_key in serving_raw:
+                serving_raw = dict(
+                    serving_raw, **{tup_key: tuple(serving_raw[tup_key])})
         serving = ServingSpec(**serving_raw)
 
     loadgen_raw = raw.pop("loadgen", {})

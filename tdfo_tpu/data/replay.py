@@ -317,10 +317,15 @@ class ReplayConsumer:
             raise ValueError(f"lag_policy must be 'fail' or 'skip', "
                              f"got {lag_policy!r}")
         for col, (_, shape) in schema.items():
-            if tuple(shape) != ():
+            shape = tuple(shape)
+            # scalar-per-row (CTR) or fixed-width vector-per-row (seq eval
+            # windows / candidate panels) — anything ragged or higher-rank
+            # cannot form deterministic fixed-size batches
+            if len(shape) > 1 or (shape and int(shape[0]) <= 0):
                 raise ValueError(
-                    f"replay schema column {col!r} must be scalar-per-row, "
-                    f"got shape {tuple(shape)} — replay feeds the CTR regime")
+                    f"replay schema column {col!r} must be scalar or a "
+                    f"fixed-width 1-D vector per row, got shape {shape} — "
+                    "ragged payloads cannot batch deterministically")
         self.root = Path(root)
         self.schema = dict(schema)
         self.batch_size = int(batch_size)
@@ -432,7 +437,7 @@ class ReplayConsumer:
         if not isinstance(rows, int) or rows <= 0:
             return "bad", "missing/invalid rows", None
         cols = {}
-        for col, (dtype, _) in self.schema.items():
+        for col, (dtype, shape) in self.schema.items():
             vals = feats.get(col)
             if not isinstance(vals, list) or len(vals) != rows:
                 return "bad", f"feature {col!r} missing or wrong length", None
@@ -440,6 +445,11 @@ class ReplayConsumer:
                 arr = np.asarray(vals, dtype=dtype)
             except (ValueError, TypeError, OverflowError):
                 return "bad", f"feature {col!r} not castable to {dtype}", None
+            # enforce the per-row shape exactly (seq panels: [rows, width]);
+            # a drifted width would desync multihost lockstep downstream
+            if arr.shape != (rows, *tuple(shape)):
+                return ("bad", f"feature {col!r} has shape {arr.shape}, "
+                        f"schema says {(rows, *tuple(shape))}", None)
             cols[col] = arr
         return "train", rec, cols
 

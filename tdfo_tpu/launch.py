@@ -10,7 +10,10 @@ Subcommands:
   * ``train`` (default)      — build the Trainer from config and fit.
   * ``serve``                — export the newest checkpoint to a serving
     bundle and run the micro-batching scoring frontend (+ a retrieval round
-    for TwoTower); ``[serving] replicas > 1`` runs a multi-replica fleet
+    for TwoTower and Bert4Rec; bert4rec configs serve the SEQ family —
+    ragged histories bucketed into masked-position candidate scoring,
+    ``tdfo_tpu/serve/seq_scoring.py``); ``[serving] replicas > 1`` runs a
+    multi-replica fleet
     over one bundle store with per-replica request logs
     (``tdfo_tpu/serve/fleet.py``); knobs live in the ``[serving]`` table.
   * ``online``               — close the loop: replay the frontend's request
@@ -210,6 +213,19 @@ def main(argv: list[str] | None = None) -> int:
         # harness, tdfo_tpu/utils/faults.py) — make that impossible to miss
         # in the launch log of a run that mysteriously dies with exit 17
         print(f"WARNING: fault injection armed: {cfg.faults}", flush=True)
+    if args.command in ("serve", "serve-fleet", "loadgen", "online"):
+        # explicit model-kind dispatch: resolve the serving family ONCE at
+        # the entry point so an unsupported model dies here with the family
+        # map (CTR = twotower/dlrm, seq = bert4rec) instead of deep in a
+        # scorer traceback
+        from tdfo_tpu.core.config import serving_model_kind
+
+        try:
+            kind = serving_model_kind(cfg)
+        except ValueError as e:
+            raise SystemExit(str(e)) from None
+        print(f"{args.command}: model {cfg.model!r} -> "
+              f"{kind} serving family", flush=True)
     if args.command == "serve":
         from tdfo_tpu.serve.frontend import serve_from_config
 

@@ -161,6 +161,11 @@ class ServingBundle:
     tables: dict[str, np.ndarray] | None  # sparse kind
     dense_params: dict | None  # sparse kind
     params: dict | None  # dense kind
+    # sequence-model hyperparameters (bert4rec bundles: max_len / n_heads /
+    # n_layers — the backbone geometry the scorer must rebuild EXACTLY; a
+    # drifted max_len would silently mis-position the appended MASK).  None
+    # for the CTR family.
+    seq: dict | None = None
     version: int = 0  # chain position (delta exports stack on this)
     digest: str = ""  # manifest content digest (see bundle_digest)
 
@@ -270,6 +275,7 @@ def export_bundle(
     mixed_precision: bool = False,
     platform: str | None = None,
     version: int = 0,
+    seq: Mapping[str, int] | None = None,
 ) -> Path:
     """Write a serving bundle directory and return its path.
 
@@ -284,6 +290,9 @@ def export_bundle(
     ``version`` is the bundle's chain position (delta exports stack on top
     of it, :func:`export_delta`); the manifest also stamps a content
     ``digest`` so consumers can verify integrity end to end.
+    ``seq``: sequence-model hyperparameters (bert4rec: max_len / n_heads /
+    n_layers) stamped into the manifest so the serving scorer rebuilds the
+    exact backbone geometry — and so deltas refuse max_len drift.
     """
     if (coll is None) == (params is None):
         raise ValueError(
@@ -294,14 +303,14 @@ def export_bundle(
         model=model, embed_dim=embed_dim, cat_columns=cat_columns,
         cont_columns=cont_columns, size_map=size_map, step=step, coll=coll,
         tables=tables, dense_params=dense_params, params=params,
-        caches=caches, dtype=dtype, version=version)
+        caches=caches, dtype=dtype, version=version, seq=seq)
     manifest["digest"] = bundle_digest(manifest, arrays)
     return write_raw_bundle(out_dir, manifest, arrays)
 
 
 def _materialize(
     *, model, embed_dim, cat_columns, cont_columns, size_map, step, coll,
-    tables, dense_params, params, caches, dtype, version,
+    tables, dense_params, params, caches, dtype, version, seq=None,
 ) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
     """Shared bundle materialization: (manifest sans digest, stored arrays)."""
     dtype_name = jnp.dtype(dtype).name
@@ -318,6 +327,8 @@ def _materialize(
         "dtype": dtype_name,
         "version": int(version),
     }
+    if seq is not None:
+        manifest["seq"] = {k: int(v) for k, v in dict(seq).items()}
     if coll is not None:
         if tables is None or dense_params is None:
             raise ValueError("sparse export needs tables and dense_params")
@@ -360,6 +371,7 @@ def export_delta(
     mixed_precision: bool = False,
     platform: str | None = None,
     touched: Mapping[str, np.ndarray] | None = None,
+    seq: Mapping[str, int] | None = None,
 ) -> Path:
     """Export only the rows that changed since the ``base_dir`` bundle.
 
@@ -394,9 +406,11 @@ def export_delta(
         model=model, embed_dim=embed_dim, cat_columns=cat_columns,
         cont_columns=cont_columns, size_map=size_map, step=step, coll=coll,
         tables=tables, dense_params=dense_params, params=None, caches=caches,
-        dtype=dtype, version=int(base_manifest["version"]) + 1)
+        dtype=dtype, version=int(base_manifest["version"]) + 1, seq=seq)
+    # "seq" freezes the bert4rec backbone geometry (max_len/n_heads/
+    # n_layers); CTR bundles compare absent == absent, no behaviour change
     frozen = ("kind", "model", "embed_dim", "cat_columns", "cont_columns",
-              "size_map", "dtype", "tables")
+              "size_map", "dtype", "tables", "seq")
     for key in frozen:
         if new_manifest.get(key) != base_manifest.get(key):
             raise ValueError(
@@ -601,6 +615,8 @@ def bundle_from_raw(manifest: Mapping[str, Any],
         tables=tables,
         dense_params=dense_params,
         params=params,
+        seq=({k: int(v) for k, v in manifest["seq"].items()}
+             if manifest.get("seq") else None),
         version=int(manifest.get("version", 0)),
         digest=str(manifest.get("digest", "")),
     )

@@ -41,7 +41,7 @@ from tdfo_tpu.serve.export import load_bundle
 from tdfo_tpu.serve.frontend import MicroBatcher
 from tdfo_tpu.serve.scoring import make_scorer
 from tdfo_tpu.serve.swap import BundleStore, _version_name
-from tdfo_tpu.train.metrics import binary_auc
+from tdfo_tpu.train.metrics import binary_auc, ranking_auc
 from tdfo_tpu.utils import faults as _faults
 
 __all__ = ["ReplicaFrontend", "ServingFleet"]
@@ -116,10 +116,13 @@ class ReplicaFrontend:
         if skewed:
             # training/serving skew stand-in: healthy bytes, wrong logits.
             # No model call — deterministic, and independent of how well
-            # the real model fits.
-            cont_col = scorer.cont_columns[0]
+            # the real model fits.  The seq family has no continuous
+            # columns; its heuristic negates the candidate-id panel (same
+            # [n, C] output shape as the honest scorer).
+            skew_col = (scorer.cont_columns[0] if scorer.cont_columns
+                        else "cands")
 
-            def score_fn(batch, _col=cont_col):
+            def score_fn(batch, _col=skew_col):
                 return -np.asarray(batch[_col], np.float32)
 
             cache_probe = None  # nothing jitted behind the heuristic
@@ -139,9 +142,13 @@ class ReplicaFrontend:
                 return _inner(batch)
 
         self._score_fn = score_fn
+        # seq requests carry [n, max_len] history panels, so the right fill
+        # thresholds are the (smaller) [serving] history_buckets when set
+        buckets = (self.spec.history_buckets or self.spec.buckets
+                   if scorer.model == "bert4rec" else self.spec.buckets)
         if self.batcher is None:
             self.batcher = MicroBatcher(
-                score_fn, buckets=self.spec.buckets,
+                score_fn, buckets=buckets,
                 max_batch=self.spec.max_batch,
                 batch_deadline_ms=self.spec.batch_deadline_ms,
                 logger=self._logger, program_cache_size=cache_probe,
@@ -273,9 +280,12 @@ class ServingFleet:
     # ---------------------------------------------------------- heartbeat
 
     def heartbeat(self, feats: dict[str, np.ndarray],
-                  labels: np.ndarray) -> list[dict[str, Any]]:
+                  labels: np.ndarray | None) -> list[dict[str, Any]]:
         """One health sample per alive replica on a held-out slice:
-        ``{replica, version, auc, ms, canary, queue_depth, batch_fill}``
+        ``{replica, version, auc, ms, canary, queue_depth, batch_fill}``.
+        ``labels = None`` is the seq family: scores are [n, C] candidate
+        panels with the positive in column 0, judged by ``ranking_auc``
+        instead of the labelled ``binary_auc``
         (the saturation pair mirrored from the replica's micro-batcher).
         Fresh arrays per call — the scorer donates its inputs.  Each
         sample is also emitted as a ``heartbeat`` trace span: the ``ms``
@@ -298,7 +308,8 @@ class ServingFleet:
             ms = _trace.elapsed_ms(t0)
             rec: dict[str, Any] = {
                 "replica": r.replica_id, "version": r.version(),
-                "auc": binary_auc(labels, scores), "ms": ms,
+                "auc": (ranking_auc(scores) if labels is None
+                        else binary_auc(labels, scores)), "ms": ms,
                 "canary": r.canary_member,
                 # trace-clock stamp for staleness eviction: a dead replica
                 # keeps its last queue_depth/batch_fill forever, so the

@@ -362,26 +362,27 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
     """The ``python -m tdfo_tpu.launch serve`` body: restore the newest
     checkpoint (fresh init when none exists), export the serving bundle,
     build the scorer, and run a synthetic ragged request trace through the
-    micro-batcher — plus, for TwoTower, a corpus build + one retrieval
-    round so every ``[serving]`` knob is exercised by the real command.
+    micro-batcher — plus a corpus build + one retrieval round (TwoTower
+    user tower / Bert4Rec item table) so every ``[serving]`` knob is
+    exercised by the real command.  The seq family ships ragged HISTORIES:
+    each request's variable-length item history folds into the fixed eval
+    window via ``serve/seq_scoring.py:history_window`` and rides with a
+    1-positive + 100-negative candidate panel, the replayable schema.
     Returns the latency/throughput stats dict (printed by ``launch``)."""
     import jax
 
+    from tdfo_tpu.core.config import serving_model_kind
     from tdfo_tpu.serve.export import export_bundle, load_bundle
     from tdfo_tpu.serve.scoring import make_scorer
     from tdfo_tpu.train.trainer import Trainer, _ctr_columns
 
-    if config.model not in ("twotower", "dlrm"):
-        raise ValueError(
-            f"serve supports the CTR family (twotower/dlrm), not "
-            f"{config.model!r}")
+    kind = serving_model_kind(config)  # refuses unknown models actionably
     trainer = Trainer(config, log_dir=log_dir)
     state, step = trainer.state, 0
     if trainer._ckpt is not None and trainer._ckpt.latest_step() is not None:
         step, state, _ = trainer._ckpt.restore(
             trainer.state, stamps=trainer._ckpt_stamps)
 
-    cat_cols, cont_cols = _ctr_columns(config)
     out_dir = Path(log_dir or config.checkpoint_dir or ".") / "serving_bundle"
     kwargs: dict[str, Any] = {}
     if hasattr(state, "tables"):  # DMP/sparse regime
@@ -389,6 +390,13 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
                       dense_params=state.dense_params)
     else:
         kwargs = dict(params=state.params)
+    if kind == "seq":
+        cat_cols: tuple[str, ...] = ()
+        cont_cols: tuple[str, ...] = ()
+        kwargs["seq"] = {"max_len": config.max_len, "n_heads": config.n_heads,
+                         "n_layers": config.n_layers}
+    else:
+        cat_cols, cont_cols = _ctr_columns(config)
     export_bundle(
         out_dir, model=config.model, embed_dim=config.embed_dim,
         cat_columns=cat_cols, cont_columns=cont_cols,
@@ -397,8 +405,6 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
     bundle = load_bundle(out_dir)
     scorer = make_scorer(bundle, mesh=trainer.mesh)
 
-    # synthetic ragged trace: ids within each vocab, floats in [0, 1)
-    vocab = _column_vocab(config, cat_cols)
     rng = np.random.default_rng(config.seed)
     spec = config.serving
     base = Path(log_dir or config.checkpoint_dir or ".")
@@ -409,22 +415,50 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
 
         request_log = RequestLog(base / "request_log",
                                  segment_bytes=spec.log_segment_bytes)
-    # labels come from a SEPARATE rng so turning log_features on never
-    # perturbs the request trace itself (the feedback join is out-of-band)
-    label_rng = np.random.default_rng(config.seed + 1)
-    hi = min(spec.max_batch, spec.buckets[0])
+    buckets = ((spec.history_buckets or spec.buckets) if kind == "seq"
+               else spec.buckets)
+    hi = min(spec.max_batch, buckets[0])
     requests = []
-    for i in range(n_requests):
-        n = int(rng.integers(1, hi + 1))
-        batch: dict[str, np.ndarray] = {
-            c: rng.integers(0, vocab[c], size=n, dtype=np.int32)
-            for c in cat_cols
-        }
-        for c in cont_cols:
-            batch[c] = rng.random(n, dtype=np.float32)
-        if spec.log_features:
-            batch["label"] = label_rng.integers(0, 2, size=n, dtype=np.int8)
-        requests.append((f"req{i}", batch))
+    if kind == "seq":
+        # synthetic ragged-history trace: per-row histories of 1..2*max_len
+        # raw items fold into the fixed window (truncate-left, append MASK,
+        # left-pad) exactly like a live request would; the candidate panel
+        # is the replayable 1+100 eval schema, no label column (the panel's
+        # column 0 IS the feedback)
+        from tdfo_tpu.serve.seq_scoring import history_window
+
+        n_items, max_len = scorer.n_items, scorer.max_len
+        for i in range(n_requests):
+            n = int(rng.integers(1, hi + 1))
+            seqs = np.stack([
+                history_window(
+                    rng.integers(1, n_items + 1,
+                                 size=int(rng.integers(1, 2 * max_len))),
+                    n_items=n_items, max_len=max_len,
+                    max_history=spec.max_history)
+                for _ in range(n)])
+            cands = rng.integers(1, n_items + 1, size=(n, 101),
+                                 dtype=np.int32)
+            requests.append((f"req{i}", {"seqs": seqs, "cands": cands}))
+    else:
+        # synthetic ragged trace: ids within each vocab, floats in [0, 1)
+        vocab = _column_vocab(config, cat_cols)
+        # labels come from a SEPARATE rng so turning log_features on never
+        # perturbs the request trace itself (the feedback join is
+        # out-of-band)
+        label_rng = np.random.default_rng(config.seed + 1)
+        for i in range(n_requests):
+            n = int(rng.integers(1, hi + 1))
+            batch: dict[str, np.ndarray] = {
+                c: rng.integers(0, vocab[c], size=n, dtype=np.int32)
+                for c in cat_cols
+            }
+            for c in cont_cols:
+                batch[c] = rng.random(n, dtype=np.float32)
+            if spec.log_features:
+                batch["label"] = label_rng.integers(0, 2, size=n,
+                                                    dtype=np.int8)
+            requests.append((f"req{i}", batch))
 
     if fleet_mode:
         # [serving] replicas > 1: the fleet quickstart — N frontends over
@@ -472,7 +506,7 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
 
         t0 = _trace.clock()
         mb = MicroBatcher(
-            scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
+            scorer.score, buckets=buckets, max_batch=spec.max_batch,
             batch_deadline_ms=spec.batch_deadline_ms, logger=trainer.logger,
             program_cache_size=scorer.score_cache_size,
             max_queue=spec.max_queue, shed_policy=spec.shed_policy,
@@ -512,6 +546,27 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
             q_batch = {"user_id": np.arange(8, dtype=np.int32) %
                        max(vocab.get("user_id", 1), 1)}
             _, ids = retrieve(scorer.user_embed(q_batch))
+            stats["retrieved"] = int(jax.device_get(ids).shape[1])
+    elif kind == "seq":
+        # next-item retrieval: the bundle's trained item table IS the
+        # corpus (tied output head), queried by the last-position hidden
+        # state — same two-stage int8 knobs as the TwoTower path
+        from tdfo_tpu.serve.retrieval import make_retrieval
+        from tdfo_tpu.serve.seq_scoring import history_window, item_corpus
+
+        if scorer.n_items > spec.top_k:
+            corpus = item_corpus(
+                bundle, mesh=trainer.mesh,
+                dtype=spec.coarse_dtype if spec.coarse_k > 0 else "float32")
+            retrieve = make_retrieval(
+                corpus, mesh=trainer.mesh, top_k=spec.top_k,
+                coarse_k=spec.coarse_k)
+            q = np.stack([
+                history_window(
+                    rng.integers(1, scorer.n_items + 1, size=scorer.max_len),
+                    n_items=scorer.n_items, max_len=scorer.max_len)
+                for _ in range(8)])
+            _, ids = retrieve(scorer.query_embed({"seqs": q}))
             stats["retrieved"] = int(jax.device_get(ids).shape[1])
     trainer.logger.close()
     return stats

@@ -83,10 +83,16 @@ def _device_tree(tree: Any, mesh) -> Any:
     return jax.tree.map(put, tree)
 
 
-def make_scorer(bundle: ServingBundle, *, mesh=None) -> Scorer:
+def make_scorer(bundle: ServingBundle, *, mesh=None):
     """Bundle -> :class:`Scorer`.  ``mesh`` replicates the parameters over
     it (serving tables are replicated; retrieval shards the CORPUS, not the
-    tables — ``serve/retrieval.py``)."""
+    tables — ``serve/retrieval.py``).  Bert4rec bundles dispatch to the
+    sequence scorer (``serve/seq_scoring.py``) so pointer followers — fleet
+    replicas, swap controllers — serve either family through one builder."""
+    if bundle.model == "bert4rec":
+        from tdfo_tpu.serve.seq_scoring import make_seq_scorer
+
+        return make_seq_scorer(bundle, mesh=mesh)
     if bundle.kind == "dense":
         return _dense_scorer(bundle, mesh)
     return _sparse_scorer(bundle, mesh)

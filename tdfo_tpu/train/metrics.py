@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["binary_auc", "AUC", "recalls_and_ndcgs_for_ks"]
+__all__ = ["binary_auc", "ranking_auc", "AUC", "recalls_and_ndcgs_for_ks"]
 
 
 def binary_auc(labels, scores, weights=None) -> float:
@@ -49,6 +49,22 @@ def binary_auc(labels, scores, weights=None) -> float:
     below_or_eq = np.searchsorted(neg_sorted, pos, side="right")
     u = below.sum() + 0.5 * (below_or_eq - below).sum()
     return float(u / (len(pos) * len(neg)))
+
+
+def ranking_auc(scores) -> float:
+    """AUC over sampled-candidate panels: ``scores`` is [N, C] with column 0
+    the positive and columns 1.. the negatives (the torchrec eval protocol,
+    ``torchrec/train.py:44-58``) — the seq family's online-gate analogue of
+    the CTR :func:`binary_auc` over labelled rows.  Equivalent to
+    ``binary_auc`` on the flattened panel with a first-column-positive label
+    sheet; ties count half, same U statistic."""
+    s = np.asarray(scores, np.float64)
+    if s.ndim != 2 or s.shape[1] < 2:
+        raise ValueError(
+            f"ranking_auc needs [N, C>=2] candidate panels, got {s.shape}")
+    labels = np.zeros(s.shape, np.float64)
+    labels[:, 0] = 1.0
+    return binary_auc(labels.reshape(-1), s.reshape(-1))
 
 
 @jax.tree_util.register_dataclass

@@ -117,9 +117,13 @@ class OnlineLoop:
     """One supervisor process: trainer + replay consumer + bundle store +
     serving batcher, advancing in checkpointed cycles.
 
-    Restricted to the DMP/sparse CTR regime (DLRM, or TwoTower with
-    model_parallel): delta export diffs embedding tables, and online
-    freshness is an embedding-dominated problem (Monolith §3.3).
+    Restricted to the DMP/sparse regime (DLRM, TwoTower with
+    model_parallel, or Bert4Rec): delta export diffs embedding tables, and
+    online freshness is an embedding-dominated problem (Monolith §3.3).
+    The seq family (``model_kind == "seq"``) replays eval-window records
+    (``seqs``/``cands``, no label column), maps each to a last-position
+    masked-LM step, and judges shadow/canary scores by ``ranking_auc``
+    over the candidate panels instead of the labelled ``binary_auc``.
     """
 
     def __init__(self, config, *, log_dir: str | Path | None = None):
@@ -133,10 +137,12 @@ class OnlineLoop:
             raise ValueError(
                 "the online loop needs [online] request_log — the directory "
                 "a serving frontend (serve --serving.log_features) wrote")
-        if config.model not in ("twotower", "dlrm"):
-            raise ValueError(
-                f"online supports the CTR family (twotower/dlrm), not "
-                f"{config.model!r}")
+        from tdfo_tpu.core.config import serving_model_kind
+
+        # rejects unknown models with the actionable family map; bert4rec
+        # joins as the "seq" family (replayed candidate panels, ranking_auc
+        # gates, label-free heartbeats)
+        self.model_kind = serving_model_kind(config)
         if jax.process_count() > 1:
             raise ValueError(
                 "the online supervisor is single-process (one serving "
@@ -238,17 +244,27 @@ class OnlineLoop:
     # ----------------------------------------------------------- store side
 
     def _export_kwargs(self) -> dict[str, Any]:
-        from tdfo_tpu.train.trainer import _ctr_columns
-
         cfg = self.config
-        cat_cols, cont_cols = _ctr_columns(cfg)
         state = self.trainer.state
+        if self.model_kind == "seq":
+            # seq bundles carry no CTR columns; the manifest's seq block is
+            # the backbone geometry the scorer rebuilds (and the drift key
+            # export_delta refuses on)
+            cat_cols: tuple[str, ...] = ()
+            cont_cols: tuple[str, ...] = ()
+            seq = {"max_len": cfg.max_len, "n_heads": cfg.n_heads,
+                   "n_layers": cfg.n_layers}
+        else:
+            from tdfo_tpu.train.trainer import _ctr_columns
+
+            cat_cols, cont_cols = _ctr_columns(cfg)
+            seq = None
         return dict(
             model=cfg.model, embed_dim=cfg.embed_dim, cat_columns=cat_cols,
             cont_columns=cont_cols, size_map=cfg.size_map, step=self.gstep,
             coll=self.trainer.coll, tables=state.tables,
             dense_params=state.dense_params,
-            mixed_precision=cfg.mixed_precision,
+            mixed_precision=cfg.mixed_precision, seq=seq,
         )
 
     def _bootstrap_store(self) -> None:
@@ -323,8 +339,10 @@ class OnlineLoop:
 
         spec = self.config.serving
         scorer = self._build_scorer(self.store.current_dir())
+        buckets = ((spec.history_buckets or spec.buckets)
+                   if self.model_kind == "seq" else spec.buckets)
         return MicroBatcher(
-            scorer.score, buckets=spec.buckets, max_batch=spec.max_batch,
+            scorer.score, buckets=buckets, max_batch=spec.max_batch,
             batch_deadline_ms=spec.batch_deadline_ms,
             logger=self.trainer.logger,
             program_cache_size=scorer.score_cache_size,
@@ -339,6 +357,22 @@ class OnlineLoop:
 
     # ------------------------------------------------------------ the cycle
 
+    def _seq_train_batch(self, batch: dict[str, np.ndarray]
+                         ) -> dict[str, np.ndarray]:
+        """Replayed eval windows -> one masked-LM training batch.  The
+        request's ``seqs`` already carry the appended MASK at the last
+        position (``serve/seq_scoring.py:history_window``); the label sheet
+        supervises ONLY that position with the panel's positive (column 0,
+        the torchrec eval convention) — online next-item fine-tuning through
+        the SAME ``bert4rec_sparse_forward`` step as offline fit
+        (``masked_ce_loss`` ignores the ``PAD_ID`` sheet)."""
+        from tdfo_tpu.models.bert4rec import PAD_ID
+
+        item = np.asarray(batch["seqs"], np.int32)
+        label = np.full_like(item, PAD_ID)
+        label[:, -1] = np.asarray(batch["cands"], np.int32)[:, 0]
+        return {"item": item, "label": label}
+
     def _train_cycle(self, batches: list[dict[str, np.ndarray]]) -> float:
         """Run one incremental step per replay batch.  Same step program as
         offline fit — [online] adds no graph edits (jaxpr-pinned by
@@ -348,11 +382,21 @@ class OnlineLoop:
         from tdfo_tpu.data.loader import prefetch_to_mesh
         from tdfo_tpu.train.metrics import AUC
 
+        if self.model_kind == "seq":
+            batches = [self._seq_train_batch(b) for b in batches]
         trainer, loss = self.trainer, 0.0
         auc = AUC.empty() if trainer._train_auc_enabled else None
         for batch in prefetch_to_mesh(iter(batches), trainer.mesh, P("data")):
-            out = trainer.train_step(trainer.state, batch, auc)
-            trainer.state, step_loss, auc = out[:3]
+            if self.model_kind == "seq":
+                # the bert4rec step signature (trainer.py fit loop): a fixed
+                # dropout key folded with state.step — deterministic per
+                # step, so rollback-restored state replays bit for bit
+                out = trainer.train_step(trainer.state, batch,
+                                         trainer._dropout_rng)
+                trainer.state, step_loss = out[:2]
+            else:
+                out = trainer.train_step(trainer.state, batch, auc)
+                trainer.state, step_loss, auc = out[:3]
             self.gstep += 1
             loss = float(step_loss)
         trainer._flush_cache_sync()  # update cache -> tables before export
@@ -437,6 +481,15 @@ class OnlineLoop:
             outs.append(np.asarray(scorer.score(feats)))
         return np.concatenate(outs)
 
+    def _shadow_auc(self, labels, scores) -> float:
+        """The gate metric for either family: labelled rows -> binary_auc
+        (CTR); ``labels is None`` -> ranking_auc over [N, C] candidate
+        panels with the positive in column 0 (seq)."""
+        from tdfo_tpu.train.metrics import binary_auc, ranking_auc
+
+        return (ranking_auc(scores) if labels is None
+                else binary_auc(labels, scores))
+
     def _restore_last_good(self) -> None:
         """Discard the cycle's trained state: reload the last durable state
         (the previous verdict checkpoint, or the gated anchor).  ``gstep``
@@ -471,7 +524,6 @@ class OnlineLoop:
         from tdfo_tpu.serve.export import bundle_from_raw, export_delta
         from tdfo_tpu.serve.scoring import make_scorer
         from tdfo_tpu.serve.swap import CorruptDeltaError, _version_name
-        from tdfo_tpu.train.metrics import binary_auc
 
         cfg = self.config
         inj = _faults.active()
@@ -497,9 +549,17 @@ class OnlineLoop:
         shadow = self.consumer.peek_batches(cfg.online.shadow_eval_batches)
         if len(shadow) < cfg.online.shadow_eval_batches:
             return None  # no commit: wait until the held-out slice fills
-        shadow_labels = np.concatenate([b["label"] for b in shadow])
-        shadow_feats = {k: np.concatenate([b[k] for b in shadow])
-                        for k in shadow[0] if k != "label"}
+        if self.model_kind == "seq":
+            # seq records carry no label column: candidate panels judge
+            # themselves (column 0 is the positive), so the shadow labels
+            # are None and every gate below routes through ranking_auc
+            shadow_labels = None
+            shadow_feats = {k: np.concatenate([b[k] for b in shadow])
+                            for k in shadow[0]}
+        else:
+            shadow_labels = np.concatenate([b["label"] for b in shadow])
+            shadow_feats = {k: np.concatenate([b[k] for b in shadow])
+                            for k in shadow[0] if k != "label"}
 
         _stage("train")
         st.mark("train")
@@ -535,10 +595,10 @@ class OnlineLoop:
             bundle_from_raw(manifest, arrays, source=str(delta_dir)),
             mesh=self.trainer.mesh)
         incumbent = self._build_scorer(self.store.current_dir())
-        auc_cand = binary_auc(shadow_labels,
-                              self._score_batches(candidate, shadow))
-        auc_base = binary_auc(shadow_labels,
-                              self._score_batches(incumbent, shadow))
+        auc_cand = self._shadow_auc(shadow_labels,
+                                    self._score_batches(candidate, shadow))
+        auc_base = self._shadow_auc(shadow_labels,
+                                    self._score_batches(incumbent, shadow))
 
         verdict, reason = "promote", ""
         canary_auc = stable_auc = None

@@ -16,7 +16,19 @@ kill_replica_signal / corrupt_candidate / kill_between_stages /
 kill_during_swap / slow_canary_at_cycle + slow_score_ms),
 ``fleet_mode`` ("inproc" default; "process" runs the fleet as real OS
 processes behind the socket ingress — tests/test_fleet_process.py),
-``probe_seed``.
+``probe_seed``, ``model`` ("twotower" default; "bert4rec" runs the gated
+loop over the SEQUENCE serving family — requires ``n_items`` from the seq
+preprocessing stats and request logs carrying ``seqs``/``cands`` panels)
+and ``n_items``.
+
+For the bert4rec drill the worker additionally records a served-vs-eval
+fingerprint: the SAME probe requests are scored through every replica's
+live scorer (``score_direct``) AND through the trainer's own eval chain
+(``coll.lookup -> backbone.apply -> score_candidates``, the
+``trainer.py`` seq eval step) — once BEFORE ``loop.run()`` against the
+pristine v0 head and once AFTER against the promoted head — so the test
+can assert the serving path is bitwise-equal to the eval step on both
+sides of the swap.
 
 Spoofs CPU devices and runs the REAL gated ``OnlineLoop``
 (``train/online.py`` with ``[online] canary_cycles > 0``) over a
@@ -49,10 +61,24 @@ def main() -> None:
     from tdfo_tpu.core.config import load_size_map, read_configs
     from tdfo_tpu.train.online import OnlineLoop
 
+    model = str(spec.get("model", "twotower"))
+    if model == "bert4rec":
+        # the second serving family: masked-position scoring over replay
+        # panels.  history_buckets covers the probe sizes (2/4/8) AND the
+        # heartbeat's shadow-slice batch (32 = per-device 8 x data axis 4)
+        # so the shared scorer's jit cache stays within the batcher's
+        # bounded-cache invariant.
+        model_kw = dict(model="bert4rec", n_heads=2, n_layers=1, max_len=12,
+                        sliding_step=6,
+                        size_map={"n_items": int(spec["n_items"])})
+        serving_kw = dict(max_batch=8, history_buckets=[2, 4, 8, 32])
+    else:
+        model_kw = dict(model="twotower",
+                        size_map=load_size_map(spec["data_dir"]))
+        serving_kw = {}
     cfg = read_configs(
         None,
         data_dir=spec["data_dir"],
-        model="twotower",
         model_parallel=True,
         n_epochs=1,
         learning_rate=3e-3,
@@ -61,7 +87,6 @@ def main() -> None:
         per_device_eval_batch_size=8,
         shuffle_buffer_size=500,
         log_every_n_steps=1000,
-        size_map=load_size_map(spec["data_dir"]),
         checkpoint_dir=spec["checkpoint_dir"],
         faults=dict(spec.get("faults") or {}),
         telemetry=dict(spec.get("telemetry") or {}),
@@ -73,6 +98,7 @@ def main() -> None:
             # [faults] kill_replica_signal (a real SIGKILL) instead of the
             # in-process kill_replica_nth flag
             fleet_mode=str(spec.get("fleet_mode", "inproc")),
+            **serving_kw,
         ),
         online=dict(
             request_log=spec["request_log"],
@@ -87,6 +113,7 @@ def main() -> None:
             keep_consumed_segments=int(
                 spec.get("keep_consumed_segments", 0)),
         ),
+        **model_kw,
     )
     loop = OnlineLoop(cfg, log_dir=spec["log_dir"])
     try:
@@ -95,21 +122,14 @@ def main() -> None:
         loop.close()  # even on a crash: never leak replica children
 
 
-def _probe_and_report(loop, cfg, spec: dict) -> None:
+def _ctr_probe_trace(cfg, rng):
     import numpy as np
 
-    from tdfo_tpu.serve.export import read_raw_bundle
     from tdfo_tpu.serve.frontend import _column_vocab
     from tdfo_tpu.train.trainer import _ctr_columns
 
-    stats = loop.run()
-
-    # deterministic probe trace through EVERY alive replica's live batcher:
-    # the per-replica served-logits fingerprint the fleet-convergence and
-    # bitwise-rollback acceptance compares
     cat_cols, cont_cols = _ctr_columns(cfg)
     vocab = _column_vocab(cfg, cat_cols)
-    rng = np.random.default_rng(int(spec.get("probe_seed", 606)))
     requests = []
     for i, n in enumerate((3, 5, 2, 8)):
         batch = {c: rng.integers(0, vocab[c], size=n, dtype=np.int32)
@@ -117,6 +137,102 @@ def _probe_and_report(loop, cfg, spec: dict) -> None:
         for c in cont_cols:
             batch[c] = rng.random(n, dtype=np.float32)
         requests.append((f"probe{i}", batch))
+    return requests
+
+
+def _seq_probe_trace(cfg, spec: dict, rng):
+    """Masked-position probe panels: windowed histories + candidate sets.
+
+    Sizes are drawn from the configured ``history_buckets`` so the direct
+    served-vs-eval probes below never add a jit-cache shape the batcher's
+    bounded-cache invariant did not budget for."""
+    import numpy as np
+
+    from tdfo_tpu.data.seq_preprocessing import EVAL_NEG_NUM
+    from tdfo_tpu.serve.seq_scoring import history_window
+
+    n_items = int(spec["n_items"])
+    requests = []
+    for i, n in enumerate((2, 4, 8, 8)):
+        seqs = np.stack([
+            history_window(
+                rng.integers(1, n_items + 1,
+                             size=int(rng.integers(1, 2 * cfg.max_len))),
+                n_items=n_items, max_len=cfg.max_len)
+            for _ in range(n)])
+        cands = rng.integers(
+            1, n_items + 1, size=(n, EVAL_NEG_NUM + 1)).astype(np.int32)
+        requests.append((f"probe{i}", {"seqs": seqs, "cands": cands}))
+    return requests
+
+
+def _seq_eval_chain(loop, cfg):
+    """The trainer's own seq eval step (trainer.py eval_accum inner chain):
+    the bitwise reference the served masked-position logits must equal."""
+    import jax
+
+    from tdfo_tpu.models.bert4rec import key_padding_mask
+    from tdfo_tpu.train.seq import score_candidates
+
+    coll, backbone = loop.trainer.coll, loop.trainer.backbone
+    mode = cfg.lookup_mode
+
+    @jax.jit
+    def eval_scores(tables, dense_params, seqs, cands):
+        embs = coll.lookup(tables, {"item": seqs}, mode=mode)
+        logits = backbone.apply({"params": dense_params}, embs["item"],
+                                key_padding_mask(seqs))
+        return score_candidates(logits, cands)
+
+    return eval_scores
+
+
+def _seq_served_vs_eval(loop, eval_scores, requests) -> dict:
+    """Score the probe trace through the trainer eval chain AND every alive
+    replica's live scorer (``score_direct`` — the heartbeat path, which does
+    not append to the request logs, so pre-run probes cannot perturb the
+    replayed traffic)."""
+    import numpy as np
+
+    state = loop.trainer.state
+    evals = {rid: np.asarray(eval_scores(
+                 state.tables, state.dense_params,
+                 batch["seqs"], batch["cands"])).tolist()
+             for rid, batch in requests}
+    served = {str(r.replica_id): {
+        rid: np.asarray(r.score_direct(
+            {k: np.array(v) for k, v in batch.items()})).tolist()
+        for rid, batch in requests} for r in loop.fleet.alive()}
+    return {"eval": evals, "served": served}
+
+
+def _probe_and_report(loop, cfg, spec: dict) -> None:
+    import numpy as np
+
+    from tdfo_tpu.serve.export import read_raw_bundle
+
+    # deterministic probe trace through EVERY alive replica's live batcher:
+    # the per-replica served-logits fingerprint the fleet-convergence and
+    # bitwise-rollback acceptance compares
+    rng = np.random.default_rng(int(spec.get("probe_seed", 606)))
+    served_eval = None
+    if str(spec.get("model", "twotower")) == "bert4rec":
+        requests = _seq_probe_trace(cfg, spec, rng)
+        eval_scores = _seq_eval_chain(loop, cfg)
+        # before the swap: the fleet serves the pristine v0 bundle and the
+        # trainer holds the matching pristine state
+        served_eval = {"pre": _seq_served_vs_eval(loop, eval_scores,
+                                                  requests)}
+    else:
+        requests = _ctr_probe_trace(cfg, rng)
+
+    stats = loop.run()
+
+    if served_eval is not None:
+        # after the swap: the fleet serves the promoted head and the trainer
+        # holds the state that exported it
+        served_eval["final"] = _seq_served_vs_eval(loop, eval_scores,
+                                                   requests)
     per_replica = loop.fleet.probe_each(requests)
 
     # process fleets: how often the supervisor respawned each replica (the
@@ -126,7 +242,7 @@ def _probe_and_report(loop, cfg, spec: dict) -> None:
                                     "respawns", {}).items()}
 
     manifest, _ = read_raw_bundle(loop.store.current_dir())
-    Path(spec["out_json"]).write_text(json.dumps({
+    report = {
         "stats": stats,
         "version": int(loop.store.current_version()),
         "digest": manifest["digest"],
@@ -141,7 +257,10 @@ def _probe_and_report(loop, cfg, spec: dict) -> None:
         "logits": {str(rid): {q: np.asarray(v).tolist()
                               for q, v in res.items()}
                    for rid, res in per_replica.items()},
-    }))
+    }
+    if served_eval is not None:
+        report["served_eval"] = served_eval
+    Path(spec["out_json"]).write_text(json.dumps(report))
 
 
 if __name__ == "__main__":

@@ -880,3 +880,59 @@ def test_sigkill_fault_trigger(tmp_path: Path):
     assert not FaultInjector(FaultSpec()).replica_sigkill_due()
     with pytest.raises(ValueError, match="kill_replica_signal"):
         FaultSpec(kill_replica_signal=-1)
+
+
+def test_serving_seq_family_knobs(tmp_path: Path):
+    """[serving] model_kind/max_history/history_buckets: defaults, toml
+    round-trip, rejections, and the serve/online family-dispatch map
+    (``serving_model_kind``) the launch entry points refuse through."""
+    from tdfo_tpu.core.config import ServingSpec, serving_model_kind
+
+    cfg = read_configs()
+    assert cfg.serving.model_kind == "auto"
+    assert cfg.serving.max_history == 0  # 0 = the full max_len - 1 window
+    assert cfg.serving.history_buckets == ()  # empty = reuse `buckets`
+
+    (tmp_path / "config.toml").write_text(
+        'model = "bert4rec"\n[serving]\nmodel_kind = "seq"\n'
+        "max_history = 6\nhistory_buckets = [4, 16, 64]\n")
+    cfg = read_configs(tmp_path / "config.toml")
+    assert cfg.serving.model_kind == "seq"
+    assert cfg.serving.max_history == 6
+    assert cfg.serving.history_buckets == (4, 16, 64)  # lands as a tuple
+
+    for kwargs, match in (
+        (dict(serving=ServingSpec(model_kind="bogus")), "model_kind"),
+        # an explicit kind is cross-checked against the model family
+        (dict(model="bert4rec", serving=ServingSpec(model_kind="ctr")),
+         "does not match"),
+        (dict(serving=ServingSpec(model_kind="seq")), "does not match"),
+        (dict(serving=ServingSpec(max_history=-1)), "max_history"),
+        # the window must leave room for the appended MASK position
+        (dict(max_len=8, sliding_step=4, serving=ServingSpec(max_history=8)),
+         "MASK"),
+        (dict(serving=ServingSpec(history_buckets=(8, 8))),
+         "strictly increasing"),
+        (dict(serving=ServingSpec(history_buckets=(0, 8))),
+         "history_buckets"),
+    ):
+        with pytest.raises(ValueError, match=match):
+            Config(**kwargs)
+
+    # the dispatch map: auto follows the model, explicit kinds pass through
+    assert serving_model_kind(Config()) == "ctr"
+    assert serving_model_kind(Config(model="dlrm")) == "ctr"
+    assert serving_model_kind(Config(model="bert4rec")) == "seq"
+    assert serving_model_kind(
+        Config(model="bert4rec", serving=ServingSpec(model_kind="seq"))
+    ) == "seq"
+
+    # unknown models refuse LOUDLY at the serve/online entry points (the
+    # launch.py dispatch wraps this in SystemExit) instead of shape-crashing
+    # deep in a scorer
+    class _Unmapped:
+        model = "sasrec"
+        serving = ServingSpec()
+
+    with pytest.raises(ValueError, match="no serving family"):
+        serving_model_kind(_Unmapped())

@@ -1,0 +1,245 @@
+"""Gated online-loop fleet drill for the SEQUENCE serving family: replay
+-> incremental train -> delta export -> shadow eval -> canary -> promote,
+over Bert4Rec bundles (``serve/seq_scoring.py``) instead of CTR scorers —
+the tests/test_fleet.py acceptance applied to the second model family.
+
+The request logs are written ONCE by the module fixture as a fleet layout
+of ``seqs``/``cands`` panels whose candidate column 0 (the positive,
+``torchrec/train.py:44-58``) is drawn from the TOP half of the id range
+and negatives from the bottom half: the injected skew fault serves
+negated candidate IDS as scores, so every skewed positive ranks strictly
+below every negative (flattened ranking-AUC exactly 0) while an honest
+scorer averages the random init over ~60 distinct items per side and
+sits near chance — a separation far beyond ``max_auc_regression`` with
+no training luck required.
+
+On top of the CTR drill's verdict/convergence/exactly-once audits, the
+worker records a served-vs-eval fingerprint: the same probe panels scored
+through every replica's live scorer AND through the trainer's own seq
+eval chain, BEFORE ``loop.run()`` (the pristine v0 head) and AFTER (the
+promoted head) — the served masked-position logits must equal the eval
+step bit for bit on both sides of the swap.
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tests.test_fleet import _run_worker, _run_workers
+
+LOCAL_DEVICES = 4
+BATCH_ROWS = 8 * 4  # per_device_train_batch_size x data-axis size
+STEPS_PER_CYCLE = 2
+N_CYCLES = 2  # full gated cycles the fleet logs hold
+N_REPLICAS = 2  # canary_fraction 0.5 -> replica 0 canaries, replica 1 stable
+MAX_LEN = 12
+
+
+@pytest.fixture(scope="module")
+def seq_fleet_env(tmp_path_factory):
+    """Seq-preprocessed synthetic goodreads + a per-replica fleet layout of
+    ``serve_request`` records carrying windowed histories and candidate
+    panels (what the seq frontend's micro-batcher logs for replay)."""
+    from tdfo_tpu.data.replay import RequestLog, replica_log_dir
+    from tdfo_tpu.data.seq_preprocessing import (EVAL_NEG_NUM,
+                                                 run_seq_preprocessing)
+    from tdfo_tpu.data.synthetic import write_synthetic_goodreads
+    from tdfo_tpu.serve.seq_scoring import history_window
+
+    d = tmp_path_factory.mktemp("gr_fleet_seq")
+    write_synthetic_goodreads(d, n_users=80, n_books=120,
+                              interactions_per_user=(15, 40), seed=29)
+    seq = run_seq_preprocessing(d, max_len=MAX_LEN, sliding_step=6, seed=3)
+    n_items = int(seq["n_items"])
+
+    root = tmp_path_factory.mktemp("fleetlog_seq") / "rl"
+    logs = [RequestLog(replica_log_dir(root, k), segment_bytes=4096)
+            for k in range(N_REPLICAS)]
+    rng = np.random.default_rng(11)
+    # every gated cycle consumes steps_per_cycle train batches AND peeks one
+    # shadow batch beyond them, so the log needs one extra batch of slack
+    rows_by_key: dict[tuple[int, int], int] = {}
+    total, target = 0, (N_CYCLES * STEPS_PER_CYCLE + 1) * BATCH_ROWS
+    i = 0
+    while total < target + 5:  # sub-batch tail stays unread
+        n = int(rng.integers(3, 9))
+        seqs = [history_window(
+                    rng.integers(1, n_items + 1,
+                                 size=int(rng.integers(1, 2 * MAX_LEN))),
+                    n_items=n_items, max_len=MAX_LEN).tolist()
+                for _ in range(n)]
+        # candidate panels: positives (column 0) live in the TOP half of
+        # the id range, negatives in the bottom half — the skew fault's
+        # negated-id scores then rank EVERY positive below EVERY negative
+        # (flattened AUC exactly 0), while honest scorers average the
+        # random init over ~60 items per side and sit near chance
+        half = n_items // 2 + 1
+        cands = np.concatenate(
+            [rng.integers(half, n_items + 1, size=(n, 1)),
+             rng.integers(1, half, size=(n, EVAL_NEG_NUM))],
+            axis=1).tolist()
+        rid = i % N_REPLICAS  # interleave traffic across the fleet
+        seq_no = logs[rid].append({
+            "event": "serve_request", "request": f"r{total}", "rows": n,
+            "outcome": "ok", "features": {"seqs": seqs, "cands": cands}})
+        rows_by_key[(rid, seq_no)] = n
+        total += n
+        i += 1
+    for log in logs:
+        log.close()
+    return dict(data_dir=str(d), request_log=str(root), n_items=n_items,
+                rows_by_key=rows_by_key, total_rows=total)
+
+
+def _make_spec(tmp: Path, env: dict, name: str, *, ckpt: str, log: str,
+               faults: dict | None = None, **knobs) -> Path:
+    spec = dict(
+        model="bert4rec", n_items=env["n_items"],
+        data_dir=env["data_dir"], checkpoint_dir=str(tmp / ckpt),
+        log_dir=str(tmp / log), request_log=env["request_log"],
+        out_json=str(tmp / f"{name}.json"), local_devices=LOCAL_DEVICES,
+        steps_per_cycle=STEPS_PER_CYCLE, max_cycles=0,
+        replicas=N_REPLICAS, canary_cycles=1, canary_fraction=0.5,
+        max_auc_regression=0.3, shadow_eval_batches=1,
+        faults=faults or {}, **knobs,
+    )
+    p = tmp / f"{name}_spec.json"
+    p.write_text(json.dumps(spec))
+    return p
+
+
+@pytest.fixture(scope="module")
+def seq_fleet_runs(seq_fleet_env, tmp_path_factory):
+    """The tier-1 seq acceptance drill:
+
+      * ``drill`` — ``regress_auc_at_cycle=1``: cycle 1's candidate serves
+        skewed logits on the canary cohort, must auto-rollback; cycle 2
+        retrains and promotes.
+      * ``killdrill`` — the same regression PLUS ``kill_during_canary=1``:
+        dies mid-watch with no durable verdict, then restarts the same
+        command and must converge bitwise.
+    """
+    from tdfo_tpu.utils.faults import KILL_EXIT_CODE
+
+    tmp = tmp_path_factory.mktemp("fleet_seq_runs")
+    drill_p = _make_spec(tmp, seq_fleet_env, "drill", ckpt="ckpt_drill",
+                         log="log_drill",
+                         faults={"regress_auc_at_cycle": 1})
+    kill_p = _make_spec(tmp, seq_fleet_env, "killdrill", ckpt="ckpt_kill",
+                        log="log_kill",
+                        faults={"regress_auc_at_cycle": 1,
+                                "kill_during_canary": 1})
+
+    rcs, outs = _run_workers([drill_p, kill_p])
+    assert rcs[0] == 0, f"seq drill failed rc={rcs[0]}\n{outs[0][-2000:]}"
+    assert rcs[1] == KILL_EXIT_CODE, \
+        f"expected mid-canary kill, got rc={rcs[1]}\n{outs[1][-2000:]}"
+    assert not (tmp / "killdrill.json").exists()  # died before any verdict
+    assert (tmp / "ckpt_kill" / "faults_canary_kill.marker").exists()
+
+    rc, out = _run_worker(kill_p)  # marker disarms the kill; redo the cycle
+    assert rc == 0, f"resumed killdrill failed rc={rc}\n{out[-2000:]}"
+
+    return dict(
+        drill=json.loads((tmp / "drill.json").read_text()),
+        killdrill=json.loads((tmp / "killdrill.json").read_text()),
+        drill_metrics=tmp / "log_drill" / "metrics.jsonl",
+    )
+
+
+def _events(path: Path, event: str) -> list[dict]:
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    return [r for r in recs if r.get("event") == event]
+
+
+def test_seq_drill_shadow_passes_then_canary_rolls_back(seq_fleet_runs):
+    """The skewed Bert4Rec candidate's BYTES are healthy, so it passes the
+    shadow gate (ranking-AUC over the label-free shadow panels) and reaches
+    the canary cohort — where heartbeats catch the skew (top-half positives
+    scored at the global minimum -> AUC 0) and roll it back."""
+    cycles = _events(seq_fleet_runs["drill_metrics"], "online_cycle")
+    assert [c["verdict"] for c in cycles] == ["rollback", "promote"]
+    bad = cycles[0]
+    assert bad["gated"] and bad["cycle"] == 1 and bad["version"] == 1
+    # shadow gate scored the candidate and passed it (bytes are honest)
+    assert bad["shadow_auc"] >= bad["shadow_auc_base"] - 0.3
+    # the canary watch measured the skew: near-zero AUC vs an honest stable
+    assert bad["canary_auc"] < bad["stable_auc"] - 0.3
+    assert bad["canary_auc"] < 0.1  # the constant positive pins it at ~0
+    assert "canary AUC" in bad["reason"]
+    rej = seq_fleet_runs["drill"]["rejections"]
+    assert len(rej) == 1 and rej[0]["version"] == 1
+    assert rej[0]["digest"] != seq_fleet_runs["drill"]["digest"]
+    # cycle 2 REUSES version 1 (delta chain stays parent+1) and promotes
+    good = cycles[1]
+    assert good["version"] == 1 and seq_fleet_runs["drill"]["version"] == 1
+    assert seq_fleet_runs["drill"]["canary_version"] is None
+
+
+def test_seq_served_logits_match_eval_step_across_swap(seq_fleet_runs):
+    """The acceptance bar: every replica's served masked-position logits
+    equal the trainer's seq eval step bit for bit BEFORE the swap (pristine
+    v0 head vs pristine state) and AFTER it (promoted head vs the state
+    that exported it).  JSON round-trips repr-exact floats, so list
+    equality here IS bitwise equality of the float32 scores."""
+    se = seq_fleet_runs["drill"]["served_eval"]
+    for side in ("pre", "final"):
+        evals, served = se[side]["eval"], se[side]["served"]
+        assert set(served) == {str(k) for k in range(N_REPLICAS)}
+        for rid, by_req in served.items():
+            assert by_req == evals, f"{side}: replica {rid} diverges"
+    # the swap actually happened: the promoted head scores differently
+    assert se["final"]["eval"] != se["pre"]["eval"]
+
+
+def test_seq_drill_fleet_converges_bitwise(seq_fleet_runs):
+    """After the rollback + the healthy promote, every replica serves the
+    same version and bitwise-identical probe logits through its live
+    micro-batcher — no replica is left on the rejected bundle."""
+    drill = seq_fleet_runs["drill"]
+    versions = set(drill["replica_versions"].values())
+    assert versions == {drill["version"]}
+    logits = list(drill["logits"].values())
+    assert len(logits) == N_REPLICAS
+    for other in logits[1:]:
+        assert other == logits[0]
+
+
+def test_seq_kill_during_canary_restart_converges(seq_fleet_runs):
+    """A kill mid-canary-watch + restart must converge to the uninterrupted
+    drill's exact fleet state — including the served-vs-eval fingerprint on
+    the promoted head."""
+    drill, kd = seq_fleet_runs["drill"], seq_fleet_runs["killdrill"]
+    assert kd["version"] == drill["version"]
+    assert kd["digest"] == drill["digest"]
+    assert kd["cursor"] == drill["cursor"]
+    assert kd["cycles_done"] == drill["cycles_done"]
+    assert kd["logits"] == drill["logits"]
+    assert kd["served_eval"]["final"] == drill["served_eval"]["final"]
+    assert [(r["version"], r["digest"]) for r in kd["rejections"]] == \
+        [(r["version"], r["digest"]) for r in drill["rejections"]]
+
+
+def test_seq_merged_replay_exactly_once_accounting(seq_fleet_runs,
+                                                   seq_fleet_env):
+    """The consumed ``(replica_id, seq, row_start, row_end)`` spans tile
+    each fleet record at most once with no gap and no overlap — the seq
+    panel payloads batch through the same exactly-once merger as CTR."""
+    cycles = _events(seq_fleet_runs["drill_metrics"], "online_cycle")
+    assert len(cycles) == N_CYCLES
+    spans: dict[tuple[int, int], list[tuple[int, int]]] = {}
+    for c in cycles:
+        for rid, seq_no, a, b in c["consumed"]:
+            spans.setdefault((rid, seq_no), []).append((a, b))
+    rows_by_key = seq_fleet_env["rows_by_key"]
+    assert spans, "no consumed spans logged"
+    for key, parts in spans.items():
+        parts.sort()
+        assert parts[0][0] == 0, (key, parts)
+        for (a0, b0), (a1, b1) in zip(parts, parts[1:]):
+            assert b0 == a1, f"{key}: gap or overlap at {parts}"
+        assert parts[-1][1] <= rows_by_key[key]
+    # both replicas' logs contributed to training — the merger merges
+    assert {k[0] for k in spans} == set(range(N_REPLICAS))

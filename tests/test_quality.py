@@ -841,3 +841,86 @@ def test_sockets_and_process_spawning_confined_to_serve_plumbing():
         "serve/supervisor.py (dodges framing, frame limits, retry budgets "
         "and child supervision — route through wire.listen/wire.connect or "
         "ProcessSupervisor): " + ", ".join(offenders))
+
+
+def test_pad_mask_id_literals_confined_to_protocol_homes():
+    """``models/bert4rec.py`` and ``data/seq_preprocessing.py`` are the two
+    homes of the sequence id protocol (``PAD_ID = 0``, ``MASK = n_items +
+    1``, items 1-based — torchrec/preprocessing.py:14-15).  A literal
+    re-declaration anywhere else (``PAD_ID = 0`` in a serving module) is a
+    fork: if the protocol ever moves, the fork silently pads with a REAL
+    item id and every downstream ranking is garbage with no error.  The
+    rule: no int-literal assignment to a PAD/MASK-named constant outside
+    the two homes — serving code must IMPORT ``PAD_ID`` (derivations like
+    ``mask_id = n_items + 1`` from an imported ``n_items`` stay legal, and
+    the importer audit below proves the serve path actually does import).
+    Self-tested on a synthetic offender."""
+    import ast
+    from pathlib import Path
+
+    import tdfo_tpu
+
+    root = Path(tdfo_tpu.__file__).parent
+    HOMES = {"models/bert4rec.py", "data/seq_preprocessing.py"}
+
+    def fork_lines(tree):
+        hits = []
+        for node in ast.walk(tree):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            # int literals only: bools and None are not id constants, and
+            # derivations (BinOp over an imported n_items) are not forks
+            if not (isinstance(node.value, ast.Constant)
+                    and type(node.value.value) is int):
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and (
+                        {"PAD", "MASK"} & set(t.id.upper().split("_"))):
+                    hits.append(node.lineno)
+        return hits
+
+    def pad_id_import_srcs(tree):
+        return [node.module for node in ast.walk(tree)
+                if isinstance(node, ast.ImportFrom) and node.module
+                and any(a.name == "PAD_ID" for a in node.names)]
+
+    synthetic = (
+        "PAD_ID = 0\n"
+        "MASK_TOKEN = 122\n"
+        "from tdfo_tpu.models.bert4rec import PAD_ID\n"
+        "def window(n_items):\n"
+        "    mask_id = n_items + 1\n"   # legal: a derivation, not a fork
+        "    return mask_id\n")
+    tree = ast.parse(synthetic)
+    assert fork_lines(tree) == [1, 2]
+    assert pad_id_import_srcs(tree) == ["tdfo_tpu.models.bert4rec"]
+
+    offenders, home_hits, importers = [], 0, {}
+    for path in sorted(root.rglob("*.py")):
+        rel = str(path.relative_to(root))
+        tree = ast.parse(path.read_text(), filename=str(path))
+        lines = fork_lines(tree)
+        srcs = pad_id_import_srcs(tree)
+        if srcs:
+            importers[rel] = srcs
+        if rel in HOMES:
+            home_hits += len(lines)
+            continue
+        offenders += [f"{path}:{ln}" for ln in lines]
+    assert home_hits >= 2  # the scanner sees both protocol homes
+    assert not offenders, (
+        "PAD/MASK id literal outside models/bert4rec.py + "
+        "data/seq_preprocessing.py (a fork of the sequence id protocol — "
+        "import PAD_ID instead): " + ", ".join(offenders))
+    # every importer pulls PAD_ID from a protocol home (no third-party
+    # re-export to drift behind), and the serve path IS an importer — the
+    # rule has teeth where it matters
+    home_mods = {"tdfo_tpu." + h[:-3].replace("/", ".") for h in HOMES}
+    for rel, srcs in importers.items():
+        assert set(srcs) <= home_mods, (rel, srcs)
+    assert "serve/seq_scoring.py" in importers

@@ -120,8 +120,15 @@ def test_unknown_cursor_keys_rejected(tmp_path):
 
 
 def test_non_scalar_schema_rejected(tmp_path):
-    with pytest.raises(ValueError, match="scalar-per-row"):
-        ReplayConsumer(tmp_path, schema={"seq_col": (np.int32, (16,))},
+    # fixed-width 1-D vectors (seq eval windows / candidate panels) are
+    # legal since the seq family replays; ragged/higher-rank still refuse
+    ReplayConsumer(tmp_path, schema={"seq_col": (np.int32, (16,))},
+                   batch_size=4)
+    with pytest.raises(ValueError, match="fixed-width"):
+        ReplayConsumer(tmp_path, schema={"m": (np.int32, (2, 3))},
+                       batch_size=4)
+    with pytest.raises(ValueError, match="fixed-width"):
+        ReplayConsumer(tmp_path, schema={"z": (np.int32, (0,))},
                        batch_size=4)
 
 
