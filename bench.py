@@ -1289,8 +1289,9 @@ def bench_serve_seq(batch_size: int = 8192, n_items: int = 200_000,
     """``serve_seq8``: the SEQUENCE serving family's latency twins of
     ``serve_score8``/``serve_retrieve8`` — masked-position candidate
     scoring (history window in, appended-MASK logits over the 101-wide
-    eval panel out) and next-item MIPS against the trained item-embedding
-    table reused as the corpus (``serve/seq_scoring.py:item_corpus``).
+    eval panel out) and next-item MIPS against the bias-folded output-head
+    corpus (``serve/seq_scoring.py:item_corpus``, rows ``[W_out[:,v]; b_v]``
+    so retrieval ranks exactly like the served logits).
     Timed by the same chain differencing as every other record (CLAUDE.md
     tunnel rules); each scanned batch folds the carry into its history ids
     so no two scored batches are identical (defeats result caching), and
@@ -1368,9 +1369,10 @@ def bench_serve_seq(batch_size: int = 8192, n_items: int = 200_000,
         "rows_per_sec": round(batch_size / sec, 1),
     }
 
-    # next-item retrieval: the trained item table IS the corpus — queries
-    # are last-position hidden states, here synthesized at the right shape
-    # (query_embed cost is part of the score record above)
+    # next-item retrieval: the output head IS the corpus (bias folded into
+    # a d+1th column) — queries are [h, 1] last-position hidden states,
+    # here synthesized at the right shape (query_embed cost is part of the
+    # score record above)
     corpus = item_corpus(bundle, mesh=mesh)
     retrieve = make_retrieval(corpus, mesh=mesh, top_k=top_k)
 
@@ -1388,8 +1390,10 @@ def bench_serve_seq(batch_size: int = 8192, n_items: int = 200_000,
 
     def make_retrieve_args(k, seed):
         r = np.random.default_rng(seed)
+        # query width d+1: [h, 1] against the bias-folded head corpus
         q = jax.device_put(
-            r.standard_normal((k, batch_size, embed_dim)).astype(np.float32))
+            r.standard_normal(
+                (k, batch_size, embed_dim + 1)).astype(np.float32))
         float(jnp.sum(q))
         return (q,)
 

@@ -144,7 +144,7 @@ class ReplicaFrontend:
         self._score_fn = score_fn
         # seq requests carry [n, max_len] history panels, so the right fill
         # thresholds are the (smaller) [serving] history_buckets when set
-        buckets = (self.spec.history_buckets or self.spec.buckets
+        buckets = ((self.spec.history_buckets or self.spec.buckets)
                    if scorer.model == "bert4rec" else self.spec.buckets)
         if self.batcher is None:
             self.batcher = MicroBatcher(

@@ -548,9 +548,11 @@ def serve_from_config(config, *, log_dir: str | Path | None = None,
             _, ids = retrieve(scorer.user_embed(q_batch))
             stats["retrieved"] = int(jax.device_get(ids).shape[1])
     elif kind == "seq":
-        # next-item retrieval: the bundle's trained item table IS the
-        # corpus (tied output head), queried by the last-position hidden
-        # state — same two-stage int8 knobs as the TwoTower path
+        # next-item retrieval: the bundle's output head IS the corpus
+        # (bias-folded out_proj columns — out_proj is untied, the input
+        # table would rank by the wrong function), queried by the [h, 1]
+        # last-position hidden state — same two-stage int8 knobs as the
+        # TwoTower path
         from tdfo_tpu.serve.retrieval import make_retrieval
         from tdfo_tpu.serve.seq_scoring import history_window, item_corpus
 

@@ -25,12 +25,19 @@ Request payloads are the eval schema's shapes (``trainer._eval_schema``):
 request batch DONATED and take tables/params as ARGUMENTS, never closures
 (CLAUDE.md tunnel rules).
 
-Next-item retrieval reuses the TRAINED ITEM TABLE as the corpus
-(:func:`item_corpus`): Bert4Rec's output head scores item ``v`` as
-``h_last @ W_out[:, v] + b_out[v]``, so MIPS over the item-embedding rows
-with the last-position hidden state as the query (:meth:`SeqScorer.query_embed`)
-is the table-tied retrieval head — no separate corpus sweep, the catalog
-vectors already live in the bundle.
+Next-item retrieval searches the OUTPUT HEAD as the corpus
+(:func:`item_corpus`): Bert4Rec's ``out_proj`` is an UNTIED Dense
+(``models/bert4rec.py`` — its own ``[d, V]`` kernel and bias, no weight
+tying with the input item table), and it scores item ``v`` as
+``h_last @ W_out[:, v] + b_out[v]``.  The corpus row for item ``v`` is
+therefore the head column with the bias folded in, ``[W_out[:, v]; b_out[v]]``,
+and the MIPS query is the last-position hidden state with a constant 1
+appended (:meth:`SeqScorer.query_embed`) — every corpus inner product IS
+the served logit, so retrieval ranks exactly like :meth:`SeqScorer.score`
+(pinned by ``tests/test_serve_seq.py`` against the full-catalog argsort).
+The input embedding table would rank by ``h @ e_v`` — a different function;
+no separate corpus sweep is needed either way, the head already lives in
+the bundle's dense params.
 """
 
 from __future__ import annotations
@@ -65,8 +72,9 @@ class SeqScorer:
     """Jitted sequence-serving programs bound to one bundle's parameters.
 
     ``score(batch) -> [B, C] f32`` ranks ``cands`` at the appended-MASK
-    position (batch donated).  ``query_embed(batch) -> [B, D] f32`` is the
-    last-position hidden state — the MIPS query against :func:`item_corpus`.
+    position (batch donated).  ``query_embed(batch) -> [B, D+1] f32`` is the
+    last-position hidden state with a constant 1 appended — the MIPS query
+    against the bias-folded output-head corpus of :func:`item_corpus`.
     ``cont_columns`` is empty (sequence requests carry no continuous
     features); fleet/frontend code must not assume a CTR column set.
     """
@@ -204,9 +212,14 @@ def make_seq_scorer(bundle: ServingBundle, *, mesh=None) -> SeqScorer:
 
     @jax.jit
     def query(batch, tables, dense_params):
-        # the MIPS query against item_corpus
+        # the MIPS query against item_corpus: [h, 1] — the appended
+        # constant picks up the head-bias column folded into every corpus
+        # row, so dot(query, corpus[v]) = h @ W_out[:, v] + b_out[v], the
+        # served logit itself
         h = last_hidden(tables, dense_params, batch["seqs"])
-        return h.astype(jnp.float32)
+        h = h.astype(jnp.float32)
+        return jnp.concatenate(
+            [h, jnp.ones((h.shape[0], 1), jnp.float32)], axis=1)
 
     return SeqScorer(
         model=bundle.model, embed_dim=bundle.embed_dim, max_len=cfg.max_len,
@@ -252,17 +265,37 @@ def item_corpus(
     axis: str = DATA_AXIS,
     dtype: str = "float32",
 ) -> Corpus:
-    """The bundle's trained item-embedding table as a retrieval
-    :class:`~tdfo_tpu.serve.corpus.Corpus`: rows ``1..n_items`` (PAD row 0
-    and the MASK row are reserved, never candidates), ids = the 1-based
-    catalog item ids.  Shard-aligned exactly like ``build_corpus`` (zero
-    rows, ids = -1) and storable through ``export_corpus`` / searchable by
+    """The bundle's trained OUTPUT-PROJECTION head as a retrieval
+    :class:`~tdfo_tpu.serve.corpus.Corpus`: row ``v`` is the head column
+    ``[W_out[:, v]; b_out[v]]`` (a ``[D+1]`` vector, bias folded in) for the
+    catalog items ``v = 1..n_items`` (the PAD and MASK columns are reserved,
+    never candidates), ids = the 1-based catalog item ids.  Queried with
+    :meth:`SeqScorer.query_embed` (``[h, 1]``) every inner product is the
+    served masked-position logit, so retrieval ranks exactly like
+    ``SeqScorer.score`` — ``out_proj`` is untied from the input item table
+    (``models/bert4rec.py``), which is why the table rows are NOT the
+    corpus.  Shard-aligned exactly like ``build_corpus`` (zero rows,
+    ids = -1) and storable through ``export_corpus`` / searchable by
     ``make_retrieval`` unchanged — including the int8 two-stage path."""
     if dtype not in STORAGE_DTYPES:
         raise ValueError(f"corpus dtype {dtype!r} not in {STORAGE_DTYPES}")
     n_items, _ = _check_seq_bundle(bundle)
-    table = np.asarray(bundle.tables["item_embedding"], dtype=np.float32)
-    vectors = jnp.asarray(table[1:n_items + 1])
+    op = (bundle.dense_params or {}).get("out_proj")
+    if not isinstance(op, Mapping) or "kernel" not in op or "bias" not in op:
+        raise ValueError(
+            "bundle dense params carry no out_proj kernel/bias — the "
+            "retrieval corpus is the output head (out_proj is untied from "
+            "the item table), so a headless bundle cannot retrieve")
+    kernel = np.asarray(op["kernel"], dtype=np.float32)  # [d, V]
+    bias = np.asarray(op["bias"], dtype=np.float32)  # [V]
+    vocab = n_items + 2
+    if kernel.shape != (bundle.embed_dim, vocab) or bias.shape != (vocab,):
+        raise ValueError(
+            f"out_proj geometry kernel{kernel.shape} bias{bias.shape} does "
+            f"not match embed_dim {bundle.embed_dim} x vocab {vocab} — "
+            "head drift; the bundle and the catalog disagree")
+    head = np.concatenate([kernel.T, bias[:, None]], axis=1)  # [V, d+1]
+    vectors = jnp.asarray(head[1:n_items + 1])
     ids = jnp.arange(1, n_items + 1, dtype=jnp.int32)
 
     n_shards = mesh.shape[axis] if mesh is not None else 1

@@ -55,16 +55,20 @@ def ranking_auc(scores) -> float:
     """AUC over sampled-candidate panels: ``scores`` is [N, C] with column 0
     the positive and columns 1.. the negatives (the torchrec eval protocol,
     ``torchrec/train.py:44-58``) — the seq family's online-gate analogue of
-    the CTR :func:`binary_auc` over labelled rows.  Equivalent to
-    ``binary_auc`` on the flattened panel with a first-column-positive label
-    sheet; ties count half, same U statistic."""
+    the CTR :func:`binary_auc` over labelled rows.  PER-ROW: each panel's
+    positive is ranked against its OWN negatives (win = 1, tie = 0.5 — the
+    row-level U statistic) and rows average, so per-user score-scale shifts
+    (common in seq models) cannot move the gate while within-panel ranking
+    is unchanged.  Pooling all panels into one flat Mann-Whitney statistic
+    would compare positives against other users' negatives — deliberately
+    NOT what a sampled-panel gate should measure."""
     s = np.asarray(scores, np.float64)
     if s.ndim != 2 or s.shape[1] < 2:
         raise ValueError(
             f"ranking_auc needs [N, C>=2] candidate panels, got {s.shape}")
-    labels = np.zeros(s.shape, np.float64)
-    labels[:, 0] = 1.0
-    return binary_auc(labels.reshape(-1), s.reshape(-1))
+    pos, neg = s[:, :1], s[:, 1:]
+    wins = (pos > neg).sum(axis=1) + 0.5 * (pos == neg).sum(axis=1)
+    return float(np.mean(wins / neg.shape[1]))
 
 
 @jax.tree_util.register_dataclass

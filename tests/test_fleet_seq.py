@@ -8,10 +8,10 @@ of ``seqs``/``cands`` panels whose candidate column 0 (the positive,
 ``torchrec/train.py:44-58``) is drawn from the TOP half of the id range
 and negatives from the bottom half: the injected skew fault serves
 negated candidate IDS as scores, so every skewed positive ranks strictly
-below every negative (flattened ranking-AUC exactly 0) while an honest
-scorer averages the random init over ~60 distinct items per side and
-sits near chance — a separation far beyond ``max_auc_regression`` with
-no training luck required.
+below its own panel's negatives (per-row ranking-AUC exactly 0) while an
+honest scorer averages the random init over ~60 distinct items per side
+and sits near chance — a separation far beyond ``max_auc_regression``
+with no training luck required.
 
 On top of the CTR drill's verdict/convergence/exactly-once audits, the
 worker records a served-vs-eval fingerprint: the same probe panels scored
@@ -72,9 +72,9 @@ def seq_fleet_env(tmp_path_factory):
                 for _ in range(n)]
         # candidate panels: positives (column 0) live in the TOP half of
         # the id range, negatives in the bottom half — the skew fault's
-        # negated-id scores then rank EVERY positive below EVERY negative
-        # (flattened AUC exactly 0), while honest scorers average the
-        # random init over ~60 items per side and sit near chance
+        # negated-id scores then rank every positive below its own panel's
+        # negatives (per-row AUC exactly 0), while honest scorers average
+        # the random init over ~60 items per side and sit near chance
         half = n_items // 2 + 1
         cands = np.concatenate(
             [rng.integers(half, n_items + 1, size=(n, 1)),
@@ -156,9 +156,10 @@ def _events(path: Path, event: str) -> list[dict]:
 
 def test_seq_drill_shadow_passes_then_canary_rolls_back(seq_fleet_runs):
     """The skewed Bert4Rec candidate's BYTES are healthy, so it passes the
-    shadow gate (ranking-AUC over the label-free shadow panels) and reaches
-    the canary cohort — where heartbeats catch the skew (top-half positives
-    scored at the global minimum -> AUC 0) and roll it back."""
+    shadow gate (per-row ranking-AUC over the label-free shadow panels) and
+    reaches the canary cohort — where heartbeats catch the skew (top-half
+    positives scored below every in-panel negative -> AUC 0) and roll it
+    back."""
     cycles = _events(seq_fleet_runs["drill_metrics"], "online_cycle")
     assert [c["verdict"] for c in cycles] == ["rollback", "promote"]
     bad = cycles[0]

@@ -5,7 +5,12 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tdfo_tpu.train.metrics import AUC, binary_auc, recalls_and_ndcgs_for_ks
+from tdfo_tpu.train.metrics import (
+    AUC,
+    binary_auc,
+    ranking_auc,
+    recalls_and_ndcgs_for_ks,
+)
 
 
 def _brute_auc(labels, scores):
@@ -74,6 +79,39 @@ class TestStreamingAUC:
 
     def test_empty_is_nan(self):
         assert np.isnan(float(AUC.empty().result()))
+
+
+class TestRankingAUC:
+    """The seq-family gate metric: PER-ROW rank of column 0 (the positive)
+    against its own panel's negatives, averaged — not a pooled flat
+    Mann-Whitney statistic, so per-user score-scale shifts cannot move it."""
+
+    def test_matches_mean_per_row_binary_auc(self):
+        rng = np.random.default_rng(3)
+        s = rng.random((40, 11))
+        labels = np.zeros((11,))
+        labels[0] = 1.0
+        per_row = [binary_auc(labels, row) for row in s]
+        assert ranking_auc(s) == pytest.approx(np.mean(per_row))
+
+    def test_perfect_inverted_and_ties(self):
+        assert ranking_auc(np.array([[0.9, 0.1, 0.2], [0.8, 0.0, 0.3]])) == 1.0
+        assert ranking_auc(np.array([[0.1, 0.9, 0.2], [0.0, 0.8, 0.3]])) == 0.0
+        assert ranking_auc(np.full((4, 5), 0.5)) == pytest.approx(0.5)
+
+    def test_per_row_score_shifts_do_not_move_the_gate(self):
+        # the property pooling breaks: adding a per-user offset leaves every
+        # within-panel ranking (and so the metric) unchanged
+        rng = np.random.default_rng(4)
+        s = rng.random((30, 8))
+        shifted = s + rng.normal(0.0, 100.0, size=(30, 1))
+        assert ranking_auc(shifted) == pytest.approx(ranking_auc(s))
+
+    def test_rejects_wrong_shapes(self):
+        with pytest.raises(ValueError, match="candidate panels"):
+            ranking_auc(np.zeros((5,)))
+        with pytest.raises(ValueError, match="candidate panels"):
+            ranking_auc(np.zeros((5, 1)))
 
 
 class TestRankingMetrics:

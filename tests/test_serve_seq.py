@@ -10,10 +10,12 @@ The contracts under test, in order of importance:
   * ragged histories batch through the SAME bounded-jit-cache discipline as
     CTR traffic — ``history_window`` fixes the row shape, bucket padding
     fixes the batch shape, so compiled programs stay <= len(buckets);
-  * next-item retrieval reuses the TRAINED item table as the corpus
-    (``item_corpus``) and inherits the retrieval contracts unchanged:
-    exact-path bitwise equality to the stable-argsort reference, and the
-    int8 two-stage path holding its recall floor;
+  * next-item retrieval searches the OUTPUT head as the corpus
+    (``item_corpus``: bias-folded out_proj columns — NOT the input item
+    table, out_proj is untied) so MIPS ranks exactly like ``score()``, and
+    inherits the retrieval contracts unchanged: exact-path bitwise equality
+    to the stable-argsort reference, and the int8 two-stage path holding
+    its recall floor;
   * request-log replay forms deterministic [B, width] panels from seq
     feature payloads and quarantines width drift (the multihost-lockstep
     guard of ``trainer._eval_schema`` extended to the serve->retrain loop).
@@ -167,20 +169,24 @@ def test_make_scorer_dispatches_seq_family(mesh8, tmp_path):
     assert scorer.mask_id == CFG.n_items + 1
 
 
-def test_query_embed_is_the_tied_retrieval_head(mesh8, tmp_path):
-    """``query_embed`` must be the hidden state FEEDING out_proj: pushing it
-    through the output head by hand reproduces the served candidate scores
-    (the tied-table identity next-item retrieval relies on)."""
+def test_query_embed_is_the_retrieval_head_query(mesh8, tmp_path):
+    """``query_embed`` must be ``[h, 1]`` — the hidden state FEEDING
+    out_proj with the constant that picks up the bias column: pushing it
+    through the bias-folded output head by hand reproduces the served
+    candidate scores (the identity next-item retrieval relies on; out_proj
+    is UNTIED, so the input table would be the wrong head)."""
     coll, backbone, state = _bert4rec_sparse(mesh8)
     batch = _seq_batch(np.random.default_rng(11), 8)
     bundle = load_bundle(_export_seq(tmp_path / "b", coll, state))
     scorer = make_seq_scorer(bundle, mesh=mesh8)
 
     q = np.asarray(scorer.query_embed(dict(batch)))
-    assert q.shape == (8, CFG.embed_dim) and q.dtype == np.float32
+    assert q.shape == (8, CFG.embed_dim + 1) and q.dtype == np.float32
+    np.testing.assert_array_equal(q[:, -1], 1.0)
     W = np.asarray(bundle.dense_params["out_proj"]["kernel"])
     b = np.asarray(bundle.dense_params["out_proj"]["bias"])
-    manual = np.take_along_axis(q @ W + b, batch["cands"], axis=1)
+    head = np.concatenate([W, b[None, :]], axis=0)  # [d+1, V]
+    manual = np.take_along_axis(q @ head, batch["cands"], axis=1)
     ref = np.asarray(scorer.score(dict(batch)))
     np.testing.assert_allclose(manual, ref, rtol=2e-5, atol=2e-5)
 
@@ -305,25 +311,34 @@ def test_microbatcher_seq_panels_and_compile_pin(mesh8, tmp_path):
 
 
 def test_item_corpus_layout(mesh8, tmp_path):
-    """Rows 1..n_items of the trained table, 1-based catalog ids, PAD/MASK
-    rows excluded, shard padding id -1 — ``build_corpus``'s alignment
-    contract on the bundle's own table."""
+    """Bias-folded out_proj columns 1..n_items (each row ``[W[:, v]; b_v]``,
+    width d+1), 1-based catalog ids, PAD/MASK columns excluded, shard
+    padding id -1 — ``build_corpus``'s alignment contract on the bundle's
+    own output head."""
     coll, _, state = _bert4rec_sparse(mesh8)
     bundle = load_bundle(_export_seq(tmp_path / "b", coll, state))
     corpus = item_corpus(bundle, mesh=mesh8)
     assert corpus.n_items == CFG.n_items
     n_pad = -(-CFG.n_items // mesh8.shape["data"]) * mesh8.shape["data"]
-    assert corpus.vectors.shape == (n_pad, CFG.embed_dim)
+    assert corpus.vectors.shape == (n_pad, CFG.embed_dim + 1)
     ids = np.asarray(corpus.ids)
     np.testing.assert_array_equal(ids[:CFG.n_items],
                                   np.arange(1, CFG.n_items + 1))
     assert (ids[CFG.n_items:] == -1).all()
-    table = np.asarray(bundle.tables["item_embedding"], np.float32)
+    W = np.asarray(bundle.dense_params["out_proj"]["kernel"], np.float32)
+    b = np.asarray(bundle.dense_params["out_proj"]["bias"], np.float32)
+    head = np.concatenate([W.T, b[:, None]], axis=1)  # [V, d+1]
     np.testing.assert_array_equal(
         np.asarray(corpus.vectors)[:CFG.n_items],
-        table[1:CFG.n_items + 1])
+        head[1:CFG.n_items + 1])
     with pytest.raises(ValueError, match="not in"):
         item_corpus(bundle, mesh=mesh8, dtype="int4")
+    with pytest.raises(ValueError, match="no out_proj"):
+        item_corpus(_toy_bundle())
+    with pytest.raises(ValueError, match="head drift"):
+        item_corpus(_toy_bundle(dense_params={"out_proj": {
+            "kernel": np.zeros((CFG.embed_dim, CFG.n_items + 1), np.float32),
+            "bias": np.zeros((CFG.n_items + 1,), np.float32)}}))
 
 
 def test_item_retrieval_exact_matches_reference(mesh8, tmp_path):
@@ -342,6 +357,50 @@ def test_item_retrieval_exact_matches_reference(mesh8, tmp_path):
         np.testing.assert_array_equal(
             np.asarray(scores).view(np.uint32),
             np.asarray(ref_s).view(np.uint32))
+
+
+def test_item_retrieval_ranks_like_the_served_scores(mesh8, tmp_path):
+    """THE identity the corpus exists for: MIPS top-k over ``item_corpus``
+    agrees with the argsort of the SERVED full-catalog logits — ``score()``
+    with every catalog item as a candidate.  out_proj is untied, so a
+    corpus built from the input item table ranks by ``h @ e_v`` instead of
+    ``h @ W[:, v] + b_v`` and fails this by a wide margin.  ``mips_scores``
+    runs bf16 x bf16 -> f32 while ``score()`` is an f32 matmul, so adjacent
+    ranks inside the bf16 rounding bound may legitimately swap: the
+    retrieved items' exact logits must match the true top-k logits within
+    that bound everywhere, and the id lists must agree exactly wherever the
+    k-boundary gap exceeds it."""
+    coll, _, state = _bert4rec_sparse(mesh8)
+    bundle = load_bundle(_export_seq(tmp_path / "b", coll, state))
+    scorer = make_seq_scorer(bundle, mesh=mesh8)
+    corpus = item_corpus(bundle, mesh=mesh8)
+
+    n = 16
+    batch = _seq_batch(np.random.default_rng(13), n)
+    catalog = np.arange(1, CFG.n_items + 1, dtype=np.int32)
+    q = np.asarray(scorer.query_embed(dict(batch)))
+    full = np.asarray(scorer.score(
+        {"seqs": batch["seqs"], "cands": np.tile(catalog, (n, 1))}))
+    # per-row bf16 dot-product error bound: sum_i |q_i||c_i| * 2^-7 covers
+    # rounding both operands to bf16 (8-bit mantissa) before the f32 matmul
+    head = np.asarray(jax.device_get(corpus.vectors))[:CFG.n_items]
+    tol = (np.abs(q) @ np.abs(head).T).max(axis=1) * 2.0 ** -7  # [n]
+
+    for k in (1, 10):
+        _, ids_ret = make_retrieval(corpus, mesh=mesh8, top_k=k)(q)
+        ids_ret = np.asarray(ids_ret)
+        for row in range(n):
+            order = np.argsort(-full[row], kind="stable")
+            best = full[row, order[:k]]
+            got = full[row, ids_ret[row] - 1]
+            assert np.all(best - got <= tol[row]), (
+                f"row {row} top-{k}: retrieved items' served logits trail "
+                f"the true top-k by {(best - got).max()} > {tol[row]} — the "
+                "corpus is not the output head")
+            boundary_gap = full[row, order[k - 1]] - full[row, order[k]]
+            if boundary_gap > 2 * tol[row]:
+                assert set(map(int, ids_ret[row])) == \
+                    set(map(int, catalog[order[:k]])), f"row {row} top-{k}"
 
 
 def _recall(ids, ids_ref):
